@@ -1,0 +1,39 @@
+"""Test harness: simulate an 8-device TPU-like mesh on CPU.
+
+The reference could only test distributed behavior on a real cluster
+(SURVEY.md §4).  JAX lets us do better:
+``--xla_force_host_platform_device_count=8`` gives 8 virtual CPU
+devices, so collectives, shardings and all four rules' merge arithmetic
+get real unit tests without hardware.
+
+NOTE: this environment pre-registers an experimental TPU PJRT plugin
+via sitecustomize and sets JAX_PLATFORMS=axon, so we must both set the
+XLA flag *and* force the cpu platform before any backend is created.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices8):
+    from theanompi_tpu.parallel import data_mesh
+
+    return data_mesh(8, devices8)

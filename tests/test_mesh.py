@@ -1,0 +1,44 @@
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel import (
+    AXIS_DATA,
+    MeshSpec,
+    data_axis_size,
+    local_batch,
+    make_training_mesh,
+    shard_batch,
+)
+
+
+def test_data_mesh_shape(mesh8):
+    assert data_axis_size(mesh8) == 8
+    assert mesh8.shape[AXIS_DATA] == 8
+
+
+def test_mesh_spec_degrees():
+    d = MeshSpec(data=-1, model=2).degrees(8)
+    assert d[AXIS_DATA] == 4 and d["model"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, model=3).degrees(8)
+
+
+def test_mixed_axes_mesh(devices8):
+    mesh = make_training_mesh(MeshSpec(data=4, model=2), devices8)
+    assert mesh.shape[AXIS_DATA] == 4
+    assert mesh.shape["model"] == 2
+
+
+def test_local_batch(mesh8):
+    assert local_batch(256, mesh8) == 32
+    with pytest.raises(ValueError):
+        local_batch(100, mesh8)
+
+
+def test_shard_batch_places_on_mesh(mesh8):
+    x = np.zeros((16, 3), np.float32)
+    sx = shard_batch(x, mesh8)
+    assert sx.sharding.spec == shard_batch(np.zeros((16,)), mesh8).sharding.spec
+    # each device holds 2 rows
+    shard_shapes = {s.data.shape for s in sx.addressable_shards}
+    assert shard_shapes == {(2, 3)}

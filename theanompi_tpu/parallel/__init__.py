@@ -1,0 +1,38 @@
+from theanompi_tpu.parallel.exchanger import (
+    BSP_Exchanger,
+    asgd_apply_grads,
+    easgd_both_updates,
+    easgd_center_update,
+    easgd_worker_update,
+    gosgd_merge,
+)
+from theanompi_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    MeshSpec,
+    batch_sharding,
+    data_axis_size,
+    data_mesh,
+    local_batch,
+    make_training_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)
+from theanompi_tpu.parallel.bsp import (
+    TrainState,
+    make_bsp_eval_step,
+    make_bsp_train_step,
+)
+
+__all__ = [
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
+    "MeshSpec", "make_training_mesh", "data_mesh", "batch_sharding",
+    "replicated", "replicate", "shard_batch", "local_batch", "data_axis_size",
+    "BSP_Exchanger", "easgd_worker_update", "easgd_center_update",
+    "easgd_both_updates", "asgd_apply_grads", "gosgd_merge",
+    "TrainState", "make_bsp_train_step", "make_bsp_eval_step",
+]

@@ -1,0 +1,149 @@
+"""BSP training as a single SPMD program.
+
+In the reference, BSP was a subsystem: each rank ran ``train_iter()``
+then called ``BSP_Exchanger.exchange()`` to allreduce gradients over
+MPI/NCCL (reference layout ``theanompi/lib/exchanger.py`` + the BSP
+worker module; SURVEY.md §2.3–§2.4, §3.2 — mount empty, no file:line).
+
+On TPU, BSP is a compiler annotation: one jitted step, ``shard_map``-ped
+over the ``data`` axis of a mesh, with the exchange traced inside it as
+``psum``.  XLA schedules the ICI collectives and overlaps them with the
+backward pass — the calc/comm overlap the reference could only
+approximate with multi-stream tricks falls out of the compiler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+PyTree = Any
+
+# loss_fn(params, model_state, batch, rng) -> (loss, (new_model_state, metrics))
+LossFn = Callable[[PyTree, PyTree, PyTree, jax.Array], tuple[jax.Array, tuple]]
+
+
+@struct.dataclass
+class TrainState:
+    """Replicated training state (params + optimizer + mutable model
+    collections such as BN batch_stats)."""
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    model_state: PyTree
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation, model_state=None):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            model_state={} if model_state is None else model_state,
+        )
+
+
+def _pmean(tree: PyTree) -> PyTree:
+    return jax.tree.map(lambda x: jax.lax.pmean(x, AXIS_DATA), tree)
+
+
+def make_bsp_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: jax.sharding.Mesh,
+    exchanger: BSP_Exchanger | None = None,
+    donate: bool = True,
+):
+    """Build the jitted SPMD training step.
+
+    Returns ``step(state, batch, rng) -> (state, metrics)`` where
+    ``state`` is replicated over the mesh, ``batch`` is a pytree whose
+    leading dim is sharded over the ``data`` axis, and ``rng`` is a
+    replicated key (folded per-shard inside for dropout decorrelation).
+    """
+    exchanger = exchanger or BSP_Exchanger()
+
+    def shard_step(state: TrainState, batch, rng):
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (new_ms, metrics)), grads = grad_fn(
+            state.params, state.model_state, batch, rng
+        )
+        metrics = dict(metrics)
+        metrics.setdefault("loss", loss)
+
+        if exchanger.exchange_what == "grads":
+            grads = exchanger.exchange(grads)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+        else:  # 'params': local update, then allreduce parameters
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            avg_exch = (
+                exchanger if exchanger.avg
+                else dataclasses.replace(exchanger, avg=True)
+            )
+            new_params = avg_exch.exchange(new_params)
+            # Momentum buffers live per-shard in 'params' mode; average
+            # them too so state stays replicated (matches the reference's
+            # param-averaging BSP semantics closely enough, and keeps the
+            # SPMD invariant that state is identical on every shard).
+            new_opt = _pmean(new_opt)
+
+        # Cross-replica sync of mutable collections (BN batch_stats):
+        # each shard saw a different micro-batch; average the stats.
+        new_ms = _pmean(new_ms)
+        metrics = _pmean(metrics)
+
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                model_state=new_ms,
+            ),
+            metrics,
+        )
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS_DATA), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_bsp_eval_step(
+    eval_fn: Callable[[PyTree, PyTree, PyTree], dict],
+    mesh: jax.sharding.Mesh,
+):
+    """Build the jitted SPMD eval step.
+
+    ``eval_fn(params, model_state, batch) -> metrics`` runs per shard;
+    metrics are pmean-ed over the data axis (the reference allreduced
+    val metrics the same way, SURVEY.md §3.5).
+    """
+
+    def shard_step(state: TrainState, batch):
+        metrics = eval_fn(state.params, state.model_state, batch)
+        return _pmean(metrics)
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P(), P(AXIS_DATA)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,152 @@
+"""Device-mesh construction and sharding helpers.
+
+TPU-native replacement for the reference's process/comm runtime
+(reference layout ``theanompi/lib/base.py`` — rank/size bookkeeping,
+GPU context init, NCCL clique bootstrap over MPI; SURVEY.md §2.6.  The
+reference mount was empty this round, so citations are to SURVEY.md,
+not file:line).
+
+Design: instead of one OS process per device with explicit rank/size
+state, we build a single :class:`jax.sharding.Mesh` with named axes and
+let XLA schedule collectives over ICI.  The reference only ever used
+data parallelism (SURVEY.md §2.11), so the default mesh is 1-D over
+``data`` — but every axis the task cares about (model/tensor, pipeline,
+sequence, expert) is reserved here so that enabling it later is a
+config change, not a rewrite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names.  Keep in sync with MeshSpec fields below.
+AXIS_DATA = "data"          # data parallel (the reference's only axis)
+AXIS_MODEL = "model"        # tensor parallel
+AXIS_PIPE = "pipe"          # pipeline parallel
+AXIS_SEQ = "seq"            # sequence/context parallel (ring attention)
+AXIS_EXPERT = "expert"      # expert parallel (MoE)
+
+ALL_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism degrees.  ``data=-1`` means "all remaining"."""
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def degrees(self, n_devices: int) -> dict[str, int]:
+        fixed = self.model * self.pipe * self.seq * self.expert
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh degrees {data}x{fixed} != device count {n_devices}"
+            )
+        return {
+            AXIS_DATA: data,
+            AXIS_MODEL: self.model,
+            AXIS_PIPE: self.pipe,
+            AXIS_SEQ: self.seq,
+            AXIS_EXPERT: self.expert,
+        }
+
+
+def make_training_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named mesh over ``devices`` (default: all local devices).
+
+    Axes of degree 1 are kept in the mesh: a size-1 named axis costs
+    nothing at runtime but lets model code annotate shardings uniformly
+    (e.g. always ``P('data', None)`` for batches) regardless of which
+    degrees are actually >1 this run.
+    """
+    spec = spec or MeshSpec()
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    degrees = spec.degrees(len(devices))
+    shape = tuple(degrees[a] for a in ALL_AXES)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, ALL_AXES)
+
+
+def data_mesh(n: int | None = None,
+              devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Pure data-parallel mesh over ``n`` devices (reference parity mode)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if n is not None:
+        if n > len(devices):
+            raise ValueError(f"requested {n} devices but only {len(devices)} available")
+        devices = devices[:n]
+    return make_training_mesh(MeshSpec(data=len(devices)), devices)
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec sharding the leading (batch) dim over data(+seq is
+    left to attention ops; batch rides ``data`` only)."""
+    del mesh
+    return P(AXIS_DATA)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[AXIS_DATA]
+
+
+def local_batch(global_batch: int, mesh: Mesh) -> int:
+    n = data_axis_size(mesh)
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by data={n}")
+    return global_batch // n
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host batch (pytree of arrays with a leading batch dim)
+    onto the mesh, sharded over the data axis.
+
+    The moral equivalent of the reference's per-rank H2D staging of its
+    data shard (SURVEY.md §3.4) — here a single ``device_put`` with a
+    NamedSharding splits the global batch across chips.
+    """
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    sh = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def log2_int(n: int) -> int:
+    b = int(math.log2(n))
+    if 2**b != n:
+        raise ValueError(f"{n} is not a power of two")
+    return b

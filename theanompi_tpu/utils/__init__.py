@@ -1,0 +1,18 @@
+from theanompi_tpu.utils.recorder import Recorder
+from theanompi_tpu.utils.helper_funcs import (
+    divide_batches,
+    get_learning_rate,
+    load_params_npz,
+    save_params_npz,
+    scale_lr,
+    set_learning_rate,
+    tree_size,
+    tree_to_vector,
+    vector_to_tree,
+)
+
+__all__ = [
+    "Recorder", "divide_batches", "scale_lr", "set_learning_rate",
+    "get_learning_rate", "tree_to_vector", "vector_to_tree", "tree_size",
+    "save_params_npz", "load_params_npz",
+]

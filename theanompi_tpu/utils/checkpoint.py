@@ -1,0 +1,60 @@
+"""Checkpoint / resume.
+
+Parity rebuild of the reference's per-epoch save/resume (rank-0 npz/
+pickle of ``self.params`` + recorder state, resume via a
+``load_epoch``-style config — SURVEY.md §5.4; mount empty, no
+file:line), built on Orbax.
+
+Cross-rule invariant (SURVEY.md §5.4): a checkpoint written by any rule
+is a valid init for any other — we store one canonical pytree
+``{params, opt_state, model_state, epoch, step}``; EASGD saves its
+center params in the same slot, so an EASGD center checkpoint restores
+cleanly into a BSP run and vice versa.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+PyTree = Any
+
+
+class Checkpointer:
+    """Thin synchronous Orbax wrapper with epoch-numbered directories."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, epoch: int, payload: PyTree, force: bool = False) -> None:
+        # Move to host numpy so the checkpoint is device-layout agnostic.
+        payload = jax.tree.map(np.asarray, payload)
+        self._mgr.save(epoch, args=ocp.args.StandardSave(payload), force=force)
+        self._mgr.wait_until_finished()
+
+    def latest_epoch(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, epoch: int | None = None, like: PyTree | None = None) -> PyTree:
+        if epoch is None:
+            epoch = self.latest_epoch()
+        if epoch is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        if like is not None:
+            like = jax.tree.map(np.asarray, like)
+            return self._mgr.restore(epoch, args=ocp.args.StandardRestore(like))
+        return self._mgr.restore(epoch)
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,138 @@
+"""Small shared helpers.
+
+Parity counterpart of the reference's ``theanompi/lib/helper_funcs.py``
+(SURVEY.md §2.7 — mount empty, no file:line).  The reference's helpers
+were MPI-buffer plumbing (``bufint``, ``dtype_to_mpi``) plus batch
+division, learning-rate scaling and npz param save/load.  The MPI
+plumbing has no TPU analogue (XLA owns the buffers); what survives is
+the arithmetic and the npz format.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+PyTree = Any
+
+
+def divide_batches(n_samples: int, batch_size: int, drop_remainder: bool = True) -> int:
+    """Number of batches per epoch (reference dropped ragged tails)."""
+    if drop_remainder:
+        return n_samples // batch_size
+    return -(-n_samples // batch_size)
+
+
+def scale_lr(lr: float, size: int, mode: str = "linear") -> float:
+    """Linear LR scaling with worker count (the reference's ``scale_lr``)."""
+    if mode == "linear":
+        return lr * size
+    if mode == "sqrt":
+        return lr * (size ** 0.5)
+    raise ValueError(f"unknown lr scaling mode {mode!r}")
+
+
+def set_learning_rate(opt_state: PyTree, lr: float) -> PyTree:
+    """Return a copy of an ``optax.inject_hyperparams`` optimizer state
+    with its learning rate rewritten — pure and structure-preserving, so
+    feeding it back into the jitted step does not retrace (the TPU
+    analogue of the reference mutating its shared ``lr`` variable in
+    ``adjust_hyperp``)."""
+    old = optax.tree_utils.tree_get(opt_state, "learning_rate")
+    if old is None:
+        raise ValueError(
+            "opt_state has no 'learning_rate' hyperparam; wrap the "
+            "optimizer in optax.inject_hyperparams to make lr mutable"
+        )
+    return optax.tree_utils.tree_set(
+        opt_state, learning_rate=jnp.asarray(lr, dtype=jnp.asarray(old).dtype)
+    )
+
+
+def get_learning_rate(opt_state: PyTree) -> float | None:
+    lr = optax.tree_utils.tree_get(opt_state, "learning_rate")
+    return None if lr is None else float(lr)
+
+
+# -- flat-vector view of a param pytree (the async rules ship params as
+#    one contiguous buffer, like the reference's flattened GPU buffers) --
+
+
+def tree_to_vector(tree: PyTree) -> tuple[np.ndarray, Any]:
+    """Flatten a pytree into one contiguous uint8 byte vector.
+
+    Byte-exact per leaf (no dtype upcast), so mixed fp32/bf16/int trees
+    round-trip losslessly and the wire size is exactly the payload size.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    arrs = [np.asarray(l) for l in leaves]
+    if arrs:
+        flat = np.concatenate([a.ravel().view(np.uint8) if a.dtype == np.uint8
+                               else np.frombuffer(a.tobytes(), np.uint8)
+                               for a in arrs])
+    else:
+        flat = np.zeros(0, np.uint8)
+    meta = (treedef, [(a.shape, a.dtype) for a in arrs])
+    return flat, meta
+
+
+def vector_to_tree(vec: np.ndarray, meta: Any) -> PyTree:
+    treedef, shapes = meta
+    leaves, off = [], 0
+    for shape, dtype in shapes:
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        leaves.append(
+            np.frombuffer(bytes(vec[off:off + nbytes]), dtype=dtype).reshape(shape)
+        )
+        off += nbytes
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def tree_size(tree: PyTree) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+# -- npz param save/load (reference parity format, SURVEY.md §2.7) --
+
+
+def _keypath_str(keypath) -> str:
+    """Stable string key for one tree path (dict keys, sequence indices
+    and attribute nodes — NamedTuples / flax.struct dataclasses)."""
+    parts = []
+    for k in keypath:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def save_params_npz(path: str, params: PyTree) -> None:
+    flat = {
+        _keypath_str(keypath): np.asarray(leaf)
+        for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **flat)
+
+
+def load_params_npz(path: str, like: PyTree) -> PyTree:
+    with np.load(path) as data:
+        flat_paths = jax.tree_util.tree_flatten_with_path(like)
+        leaves = []
+        for keypath, leaf in flat_paths[0]:
+            key = _keypath_str(keypath)
+            arr = data[key]
+            if arr.shape != leaf.shape:
+                raise ValueError(f"shape mismatch for {key}: {arr.shape} vs {leaf.shape}")
+            leaves.append(arr.astype(leaf.dtype))
+    return jax.tree.unflatten(flat_paths[1], leaves)
