@@ -1,0 +1,77 @@
+"""EASGD / ASGD / GOSGD: 4 worker threads on 4 CPU devices each, tiny
+synthetic cifar — verifies the rules run, converge, and keep their
+invariants (GOSGD weight conservation, EASGD exchange counts)."""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.base import ModelConfig
+
+
+def tiny_cfg(tmp_path, **kw):
+    base = dict(batch_size=8, n_epochs=2, learning_rate=0.01,
+                snapshot_dir=str(tmp_path), print_freq=0)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def test_easgd(tmp_path):
+    from theanompi_tpu import EASGD
+
+    rule = EASGD()
+    rule.init(devices=4, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="Cifar10_model", config=tiny_cfg(tmp_path),
+              tau=5, alpha=0.5, checkpoint=False)
+    res = rule.wait()
+    assert res["n_exchanges"] > 0
+    assert res["val"], "no validation ran"
+    assert res["val"]["error"] < 0.85  # learned something
+    # center params are finite
+    for leaf in np.asarray(res["center"]["Dense_1"]["Dense_0"]["kernel"]).ravel():
+        assert np.isfinite(leaf)
+
+
+def test_asgd(tmp_path):
+    from theanompi_tpu import ASGD
+
+    rule = ASGD()
+    rule.init(devices=4, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="Cifar10_model", config=tiny_cfg(tmp_path))
+    res = rule.wait()
+    assert res["n_updates"] > 0
+    assert res["val"]["error"] < 0.85
+
+
+def test_gosgd(tmp_path):
+    from theanompi_tpu import GOSGD
+
+    rule = GOSGD()
+    rule.init(devices=4, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="Cifar10_model", config=tiny_cfg(tmp_path),
+              p_push=0.3)
+    res = rule.wait()
+    # gossip weight conservation: in-flight items are merged at
+    # shutdown and dead-peer pushes are refused, so the sum is exactly 1
+    assert all(w > 0 for w in res["weights"])
+    assert sum(res["weights"]) == pytest.approx(1.0, abs=1e-6)
+    assert res["val"]["error"] < 0.85
+
+
+def test_easgd_center_checkpoint_loads_into_bsp(tmp_path, mesh8):
+    """Cross-rule checkpoint invariant (SURVEY.md §5.4)."""
+    from theanompi_tpu import EASGD
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.rules.bsp import run_bsp_session
+
+    cfg = tiny_cfg(tmp_path, n_epochs=1)
+    rule = EASGD()
+    rule.init(devices=2, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="Cifar10_model", config=cfg, tau=5,
+              checkpoint=True)
+    rule.wait()
+
+    # BSP resume from the EASGD center checkpoint
+    cfg2 = tiny_cfg(tmp_path, n_epochs=2)
+    model = Cifar10_model(config=cfg2, mesh=mesh8)
+    res = run_bsp_session(model, resume=True, checkpoint=True)
+    assert res["epochs_run"] == 1  # resumed at epoch 1 of 2

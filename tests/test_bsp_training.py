@@ -1,0 +1,85 @@
+"""End-to-end BSP: Cifar10 model trains (loss drops, error < chance),
+checkpoints resume, metrics flow through the recorder."""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.parallel import data_mesh
+from theanompi_tpu.rules.bsp import run_bsp_session
+from theanompi_tpu.utils import Recorder
+
+
+def small_cfg(tmp_path, **kw):
+    base = dict(batch_size=8, n_epochs=2, learning_rate=0.01,
+                snapshot_dir=str(tmp_path), print_freq=0)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def test_bsp_learns(mesh8, tmp_path):
+    cfg = small_cfg(tmp_path, n_epochs=3)
+    model = Cifar10_model(config=cfg, mesh=mesh8)
+    res = run_bsp_session(model, checkpoint=False)
+    assert res["epochs_run"] == 3
+    errs = [r["val_error"] for r in res["records"]]
+    # synthetic cifar is separable: error must drop well below chance
+    assert errs[-1] < 0.75, f"val error did not improve: {errs}"
+    assert res["records"][-1]["train_loss"] < res["records"][0]["train_loss"]
+
+
+def test_bsp_checkpoint_resume(mesh8, tmp_path):
+    cfg = small_cfg(tmp_path, n_epochs=2)
+    model = Cifar10_model(config=cfg, mesh=mesh8)
+    res1 = run_bsp_session(model, checkpoint=True)
+    assert res1["epochs_run"] == 2
+
+    # resume: a fresh model picks up at epoch 2 and runs only epoch 2
+    cfg2 = small_cfg(tmp_path, n_epochs=3)
+    model2 = Cifar10_model(config=cfg2, mesh=mesh8)
+    res2 = run_bsp_session(model2, resume=True, checkpoint=True)
+    assert res2["epochs_run"] == 1
+    # recorder reloads the full history on resume: epochs 0,1 from the
+    # first session plus the newly-run epoch 2
+    assert [r["epoch"] for r in res2["records"]] == [0, 1, 2]
+
+
+def test_bsp_rule_api(mesh8, tmp_path):
+    """The reference's rule.init(...).wait() shape (SURVEY.md §2.2)."""
+    from theanompi_tpu import BSP
+
+    cfg = small_cfg(tmp_path, n_epochs=1)
+    rule = BSP()
+    rule.init(devices=8, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="Cifar10_model", config=cfg, checkpoint=False)
+    res = rule.wait()
+    assert res["epochs_run"] == 1
+    assert "error" in res["val"]
+
+
+def test_bsp_rule_propagates_errors():
+    from theanompi_tpu import BSP
+
+    rule = BSP()
+    rule.init(devices=8, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="NoSuchClass")
+    with pytest.raises(AttributeError):
+        rule.wait()
+
+
+def test_sum_mode_with_scaled_lr_matches_avg(mesh8, tmp_path):
+    """sync_type 'cdd' (sum) with lr/N ~ 'avg' with lr (exchanger parity)."""
+    cfg_avg = small_cfg(tmp_path, n_epochs=1, seed=7)
+    m_avg = Cifar10_model(config=cfg_avg, mesh=mesh8)
+    r_avg = run_bsp_session(m_avg, sync_type="avg", checkpoint=False)
+
+    cfg_sum = small_cfg(tmp_path, n_epochs=1, seed=7, learning_rate=0.01 / 8)
+    m_sum = Cifar10_model(config=cfg_sum, mesh=mesh8)
+    r_sum = run_bsp_session(m_sum, sync_type="cdd", checkpoint=False)
+
+    # weight decay composes with lr differently across the two modes, so
+    # allow loose tolerance — but curves must be close
+    a = r_avg["records"][0]["train_loss"]
+    b = r_sum["records"][0]["train_loss"]
+    assert abs(a - b) / a < 0.15, (a, b)

@@ -1,0 +1,41 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from theanompi_tpu.ops import lrn
+
+
+def _lrn_ref(x, n, k, alpha, beta, scaled=True):
+    """Straightforward numpy LRN for cross-checking."""
+    N, H, W, C = x.shape
+    out = np.zeros_like(x)
+    a = alpha / n if scaled else alpha
+    for c in range(C):
+        lo = max(0, c - (n - 1) // 2)
+        hi = min(C, c + (n - 1 - (n - 1) // 2) + 1)
+        s = (x[..., lo:hi] ** 2).sum(axis=-1)
+        out[..., c] = x[..., c] / (k + a * s) ** beta
+    return out
+
+
+def test_lrn_matches_reference_formula():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 4, 8).astype(np.float32)
+    got = np.asarray(lrn(jnp.asarray(x), n=5, k=2.0, alpha=1e-4, beta=0.75))
+    want = _lrn_ref(x, 5, 2.0, 1e-4, 0.75, scaled=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_unscaled_variant():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 2, 6).astype(np.float32)
+    got = np.asarray(lrn(jnp.asarray(x), n=3, k=1.0, alpha=1e-3, beta=0.5,
+                         alpha_scaled_by_n=False))
+    want = _lrn_ref(x, 3, 1.0, 1e-3, 0.5, scaled=False)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_differentiable():
+    x = jnp.ones((1, 2, 2, 4))
+    g = jax.grad(lambda y: lrn(y).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()

@@ -1,0 +1,62 @@
+"""Dataset contract.
+
+Parity counterpart of the reference's data objects
+(``theanompi/models/data/`` — per-rank shard lists, shuffled epoch
+order broadcast from rank 0, train/val iterators; SURVEY.md §2.9 —
+mount empty, no file:line).
+
+TPU-native inversion: the reference gave each of N processes its own
+shard and its own iterator.  Here one controller process yields
+*global* batches (size ``batch_size * data_axis_size``) which
+``shard_batch`` splits across the mesh in a single ``device_put`` —
+the per-worker shard view becomes a sharding annotation.  The
+``rank``/``size`` arguments survive for multi-host mode, where each
+host process loads only its slice of the global batch.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterator
+
+import numpy as np
+
+Batch = tuple[np.ndarray, np.ndarray]  # (images NHWC, integer labels)
+
+
+class Dataset(abc.ABC):
+    """Iterable source of global batches for one (model, run) pair."""
+
+    #: per-shard sample shape, e.g. (32, 32, 3) — NHWC like XLA prefers
+    sample_shape: tuple[int, ...]
+    n_classes: int
+    n_train: int
+    n_val: int
+
+    @abc.abstractmethod
+    def train_batches(
+        self, epoch: int, global_batch: int, rank: int = 0, size: int = 1
+    ) -> Iterator[Batch]:
+        """Yield shuffled, augmented global train batches for ``epoch``.
+
+        Shuffle order must be a pure function of ``epoch`` (the
+        reference broadcast the epoch's shuffled file order from rank 0
+        — deriving it from the epoch number gives every host the same
+        order with no broadcast at all).
+        """
+
+    @abc.abstractmethod
+    def val_batches(
+        self, global_batch: int, rank: int = 0, size: int = 1
+    ) -> Iterator[Batch]:
+        """Yield validation batches in fixed order, no augmentation."""
+
+    def n_train_batches(self, global_batch: int) -> int:
+        from theanompi_tpu.utils.helper_funcs import divide_batches
+
+        return divide_batches(self.n_train, global_batch)
+
+    def n_val_batches(self, global_batch: int) -> int:
+        from theanompi_tpu.utils.helper_funcs import divide_batches
+
+        return divide_batches(self.n_val, global_batch)

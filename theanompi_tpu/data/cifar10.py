@@ -1,0 +1,139 @@
+"""CIFAR-10 data object.
+
+Parity counterpart of the reference's in-memory CIFAR-10 loader
+(``theanompi/models/data/cifar10.py``, SURVEY.md §2.9 — mount empty,
+no file:line).
+
+Loads the standard python-pickled CIFAR-10 batches from
+``data_dir`` (``cifar-10-batches-py``) or an ``cifar10.npz`` file with
+arrays ``x_train/y_train/x_test/y_test``.  This environment has no
+network egress, so when no data is found the loader falls back to a
+deterministic *synthetic* CIFAR-shaped dataset (class-conditional
+Gaussian blobs + structured patterns) — learnable, so smoke runs and
+tests show real convergence, and clearly labelled as synthetic.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Iterator
+
+import numpy as np
+
+from theanompi_tpu.data.base import Batch, Dataset
+from theanompi_tpu.data.utils import normalize, random_crop_flip
+
+CIFAR_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR_STD = (0.2470, 0.2435, 0.2616)
+
+
+def _load_pickled_batches(d: str):
+    xs, ys = [], []
+    for i in range(1, 6):
+        with open(os.path.join(d, f"data_batch_{i}"), "rb") as f:
+            b = pickle.load(f, encoding="bytes")
+        xs.append(b[b"data"])
+        ys.append(b[b"labels"])
+    with open(os.path.join(d, "test_batch"), "rb") as f:
+        b = pickle.load(f, encoding="bytes")
+    x_train = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    x_test = np.asarray(b[b"data"]).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return (x_train, np.concatenate(ys).astype(np.int32),
+            x_test, np.asarray(b[b"labels"], np.int32))
+
+
+def _synthetic_cifar(n_train: int, n_val: int, n_classes: int = 10,
+                     seed: int = 0, hw: int = 32):
+    """Deterministic learnable stand-in: each class is a distinct
+    low-frequency pattern + noise, so a small CNN separates them."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float32) / hw
+    protos = []
+    for c in range(n_classes):
+        fx, fy = 1 + c % 3, 1 + (c // 3) % 3
+        phase = 2 * np.pi * c / n_classes
+        base = np.sin(2 * np.pi * fx * xx + phase) * np.cos(2 * np.pi * fy * yy)
+        chan = np.stack([base * (0.5 + 0.5 * np.sin(phase + k)) for k in range(3)], -1)
+        protos.append(chan.astype(np.float32))
+    protos = np.stack(protos)  # (C, H, W, 3)
+
+    def make(n, seed_off):
+        r = np.random.default_rng(seed + seed_off)
+        y = r.integers(0, n_classes, size=n).astype(np.int32)
+        x = protos[y] + 0.35 * r.standard_normal((n, hw, hw, 3), dtype=np.float32)
+        x = ((x - x.min()) / (x.max() - x.min()) * 255).astype(np.uint8)
+        return x, y
+
+    x_tr, y_tr = make(n_train, 1)
+    x_va, y_va = make(n_val, 2)
+    return x_tr, y_tr, x_va, y_va
+
+
+class Cifar10_data(Dataset):
+    sample_shape = (32, 32, 3)
+    n_classes = 10
+
+    def __init__(self, data_dir: str | None = None, synthetic_n: int = 4096,
+                 crop: int = 32, pad: int = 4, seed: int = 0):
+        self.crop = crop
+        self.pad = pad
+        self.seed = seed
+        self.synthetic = False
+
+        candidates = []
+        if data_dir:
+            candidates += [data_dir, os.path.join(data_dir, "cifar-10-batches-py")]
+        env = os.environ.get("THEANOMPI_TPU_DATA")
+        if env:
+            candidates += [os.path.join(env, "cifar-10-batches-py"),
+                           os.path.join(env, "cifar10.npz")]
+
+        loaded = None
+        for cand in candidates:
+            if cand.endswith(".npz") and os.path.exists(cand):
+                with np.load(cand) as z:
+                    loaded = (z["x_train"], z["y_train"].astype(np.int32),
+                              z["x_test"], z["y_test"].astype(np.int32))
+                break
+            if os.path.isdir(cand) and os.path.exists(
+                os.path.join(cand, "data_batch_1")
+            ):
+                loaded = _load_pickled_batches(cand)
+                break
+
+        if loaded is None:
+            self.synthetic = True
+            loaded = _synthetic_cifar(synthetic_n, max(synthetic_n // 8, 256),
+                                      seed=seed)
+        self.x_train, self.y_train, self.x_val, self.y_val = loaded
+        self.n_train = len(self.x_train)
+        self.n_val = len(self.x_val)
+        if crop != 32:
+            self.sample_shape = (crop, crop, 3)
+
+    def _prep(self, x: np.ndarray) -> np.ndarray:
+        # pixels are uint8 0..255; mean/std are in [0,1] units
+        return normalize(x.astype(np.float32) / 255.0, CIFAR_MEAN, CIFAR_STD)
+
+    def train_batches(self, epoch: int, global_batch: int,
+                      rank: int = 0, size: int = 1) -> Iterator[Batch]:
+        order = np.random.default_rng(self.seed + 1000 + epoch).permutation(self.n_train)
+        if size > 1:
+            # async-rule mode: every worker sees a disjoint shard (the
+            # reference's per-rank file-list sharding, SURVEY.md §2.9)
+            order = order[rank::size]
+        aug_rng = np.random.default_rng(self.seed + 5000 + 7919 * epoch + rank)
+        n = len(order) // global_batch
+        for i in range(n):
+            idx = order[i * global_batch:(i + 1) * global_batch]
+            x = random_crop_flip(self.x_train[idx], self.crop, self.crop,
+                                 aug_rng, pad=self.pad)
+            yield self._prep(x), self.y_train[idx]
+
+    def val_batches(self, global_batch: int,
+                    rank: int = 0, size: int = 1) -> Iterator[Batch]:
+        n = self.n_val_batches(global_batch)
+        for i in range(n):
+            sl = slice(i * global_batch, (i + 1) * global_batch)
+            yield self._prep(self.x_val[sl]), self.y_val[sl]

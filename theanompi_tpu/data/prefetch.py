@@ -1,0 +1,94 @@
+"""Double-buffered host->device prefetch.
+
+TPU-native rebuild of the reference's parallel loader (a separate OS
+process per worker decoding the next hkl file into a shared buffer
+while the GPU trains — SURVEY.md §2.9/§3.4; mount empty, no file:line).
+
+Here the decode/augment work runs in a background thread and the
+staged result is already a *sharded device array* (``device_put`` with
+a NamedSharding), so the H2D copy for batch t+1 overlaps the device
+step for batch t — the same software double-buffering, minus the
+process boundary and shared-memory plumbing (numpy releases the GIL
+for the copy, and jax dispatch is async anyway).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+import jax
+
+from theanompi_tpu.parallel.mesh import shard_batch
+
+
+class DevicePrefetcher:
+    """Wrap a host batch iterator; yield mesh-sharded device batches.
+
+    ``depth`` is the number of batches staged ahead (2 = classic double
+    buffering).  The background thread dies with the iterator; call
+    ``close()`` (or exhaust it) to stop early.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, host_batches: Iterable, mesh, depth: int = 2):
+        self.mesh = mesh
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._worker, args=(iter(host_batches),), daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self, it: Iterator) -> None:
+        try:
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                staged = shard_batch(batch, self.mesh)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced to the consumer thread
+            self._err = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._SENTINEL, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._SENTINEL:
+            self._thread.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so the worker unblocks
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

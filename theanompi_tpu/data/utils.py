@@ -1,0 +1,59 @@
+"""Host-side augmentation: random crop + horizontal mirror.
+
+Parity with the reference's on-the-fly crop/flip in its parallel
+loader (``theanompi/models/data/utils.py`` per SURVEY.md §2.9/§3.4 —
+mount empty, no file:line).  Vectorised numpy over the whole batch
+(the reference looped per image in its loader process); kept on host
+so the device step stays static-shaped.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_crop_flip(
+    images: np.ndarray,
+    crop_h: int,
+    crop_w: int,
+    rng: np.random.Generator,
+    flip: bool = True,
+    pad: int = 0,
+) -> np.ndarray:
+    """Random-crop each NHWC image to (crop_h, crop_w) and mirror half.
+
+    ``pad`` reflects-pads H/W first (CIFAR-style 4-px padding).  When
+    the image already equals the crop size and pad=0, only flips apply.
+    """
+    n, h, w, c = images.shape
+    if pad:
+        images = np.pad(
+            images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+        )
+        h, w = h + 2 * pad, w + 2 * pad
+    if h < crop_h or w < crop_w:
+        raise ValueError(f"images {h}x{w} smaller than crop {crop_h}x{crop_w}")
+
+    ys = rng.integers(0, h - crop_h + 1, size=n)
+    xs = rng.integers(0, w - crop_w + 1, size=n)
+    # gather crops via strided fancy indexing (one pass, no python loop)
+    rows = ys[:, None, None] + np.arange(crop_h)[None, :, None]
+    cols = xs[:, None, None] + np.arange(crop_w)[None, None, :]
+    out = images[np.arange(n)[:, None, None], rows, cols]
+
+    if flip:
+        mask = rng.random(n) < 0.5
+        out[mask] = out[mask, :, ::-1]
+    return np.ascontiguousarray(out)
+
+
+def center_crop(images: np.ndarray, crop_h: int, crop_w: int) -> np.ndarray:
+    _, h, w, _ = images.shape
+    y0, x0 = (h - crop_h) // 2, (w - crop_w) // 2
+    return np.ascontiguousarray(images[:, y0:y0 + crop_h, x0:x0 + crop_w])
+
+
+def normalize(images: np.ndarray, mean, std) -> np.ndarray:
+    mean = np.asarray(mean, np.float32).reshape(1, 1, 1, -1)
+    std = np.asarray(std, np.float32).reshape(1, 1, 1, -1)
+    return (images.astype(np.float32) - mean) / std

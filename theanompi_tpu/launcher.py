@@ -1,0 +1,145 @@
+"""Launchers — ``tmlocal`` (single host) and ``tmlauncher`` (multi-host).
+
+Parity surface of the reference's console entry points (SURVEY.md
+§2.1 — mount empty, no file:line): ``tmlauncher <rule> ...`` composed
+an ``mpirun`` command with one rank per GPU; ``tmlocal`` was the
+single-node variant.
+
+TPU-native inversion (deliberate divergence, SURVEY.md §7.6): there is
+no process-per-device.  ``tmlocal`` runs the rule in-process over all
+(or the requested) local chips — BSP is one SPMD program, async rules
+are worker threads.  ``tmlauncher`` is the multi-host form: run the
+SAME command on every host with ``--coordinator host:port --nhosts N
+--host-id i``; it calls ``jax.distributed.initialize`` so the hosts
+form one global mesh over DCN, then runs the rule across
+``jax.devices()`` (one process per HOST, not per chip).
+
+Usage (matches the reference's shape):
+    tmlocal BSP -D 8 -m theanompi_tpu.models.cifar10 -c Cifar10_model
+    tmlauncher BSP --coordinator host0:1234 --nhosts 2 --host-id 0 \
+        -m theanompi_tpu.models.resnet50 -c ResNet50
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from theanompi_tpu.models import MODEL_ZOO
+
+RULES = ("BSP", "EASGD", "ASGD", "GOSGD")
+
+
+def _build_parser(multihost: bool) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tmlauncher" if multihost else "tmlocal",
+        description=__doc__.split("\n")[0],
+    )
+    p.add_argument("rule", choices=RULES, help="parallel training rule")
+    p.add_argument("-m", "--modelfile",
+                   default="theanompi_tpu.models.cifar10",
+                   help="model module path, or a zoo shortname "
+                        f"({', '.join(MODEL_ZOO)})")
+    p.add_argument("-c", "--modelclass", default=None,
+                   help="model class name (inferred for zoo shortnames)")
+    p.add_argument("-D", "--devices", type=int, default=None,
+                   help="number of local devices (default: all)")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="cap the number of epochs (for smoke runs)")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--sync-type", default="avg", choices=("avg", "cdd"))
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--tau", type=int, default=10, help="EASGD sync period")
+    p.add_argument("--alpha", type=float, default=0.5,
+                   help="EASGD elastic coefficient")
+    p.add_argument("--p-push", type=float, default=0.1,
+                   help="GOSGD per-iteration push probability")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu' with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                        "for the virtual test mesh)")
+    if multihost:
+        p.add_argument("--coordinator", required=True,
+                       help="host:port of host 0 (jax.distributed)")
+        p.add_argument("--nhosts", type=int, required=True)
+        p.add_argument("--host-id", type=int, required=True)
+    return p
+
+
+def _resolve_model(args) -> tuple[str, str]:
+    if args.modelfile in MODEL_ZOO:
+        mod, cls = MODEL_ZOO[args.modelfile]
+        return mod, args.modelclass or cls
+    if args.modelclass is None:
+        raise SystemExit("--modelclass is required for a custom --modelfile")
+    return args.modelfile, args.modelclass
+
+
+def _run(args, multihost: bool) -> int:
+    if args.platform:
+        import jax
+
+        # must land before the first backend touch; env alone can be
+        # overridden by site customizations that pre-register plugins
+        jax.config.update("jax_platforms", args.platform)
+    if multihost:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.nhosts,
+            process_id=args.host_id,
+        )
+
+    import theanompi_tpu as tm
+
+    modelfile, modelclass = _resolve_model(args)
+    rule_cls = getattr(tm, args.rule)
+    rule = rule_cls()
+
+    config = None
+    overrides = {k: v for k, v in (("batch_size", args.batch_size),
+                                   ("learning_rate", args.lr),
+                                   ("snapshot_dir", args.snapshot_dir))
+                 if v is not None}
+    if overrides:
+        from theanompi_tpu.rules import resolve_model_class
+        import dataclasses
+
+        cls = resolve_model_class(modelfile, modelclass)
+        config = dataclasses.replace(cls.default_config(), **overrides)
+
+    kwargs = dict(devices=args.devices, modelfile=modelfile,
+                  modelclass=modelclass, config=config, resume=args.resume,
+                  sync_type=args.sync_type, max_epochs=args.epochs)
+    if args.rule == "EASGD":
+        kwargs.update(tau=args.tau, alpha=args.alpha)
+    elif args.rule == "GOSGD":
+        kwargs.update(p_push=args.p_push)
+    rule.init(**kwargs)
+    result = rule.wait()
+    val = result.get("val", {})
+    if val:
+        print("final val:", {k: round(float(v), 4) for k, v in val.items()})
+    return 0
+
+
+def tmlocal(argv=None) -> int:
+    return _run(_build_parser(False).parse_args(argv), multihost=False)
+
+
+def tmlauncher(argv=None) -> int:
+    return _run(_build_parser(True).parse_args(argv), multihost=True)
+
+
+def main(argv=None) -> int:  # python -m theanompi_tpu.launcher
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--multihost":
+        return tmlauncher(argv[1:])
+    return tmlocal(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Model zoo registry (reference ``theanompi/models/`` — SURVEY.md §2.8).
+
+Models import lazily by (modulepath, classname) through
+``theanompi_tpu.rules.resolve_model_class``; this table is the
+discovery surface for launchers and docs.
+"""
+
+MODEL_ZOO = {
+    "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model"),
+    "alexnet": ("theanompi_tpu.models.alex_net", "AlexNet"),
+    "googlenet": ("theanompi_tpu.models.googlenet", "GoogLeNet"),
+    "vgg16": ("theanompi_tpu.models.vgg16", "VGG16"),
+    "resnet50": ("theanompi_tpu.models.resnet50", "ResNet50"),
+    "wgan": ("theanompi_tpu.models.wasserstein_gan", "Wasserstein_GAN"),
+}
+
+__all__ = ["MODEL_ZOO"]

@@ -1,0 +1,352 @@
+"""Model contract + generic training machinery.
+
+The reference consumed a duck-typed model contract from every rule
+(``self.params``, ``self.data``, ``batch_size``, ``n_epochs``;
+``compile_iter_fns(sync_type)``, ``train_iter(count, recorder)``,
+``val_iter(count, recorder)``, ``adjust_hyperp(epoch)``,
+``save``/``load``, ``cleanup`` — reference ``theanompi/models/*.py``,
+SURVEY.md §2.8; mount empty, no file:line).  This module keeps that
+contract — it is the API-parity surface the rules and launchers see —
+but implements it once, TPU-natively:
+
+* ``compile_iter_fns`` builds ONE jitted SPMD step (forward + backward
+  + psum exchange + update fused; XLA overlaps the ICI collectives
+  with backprop) instead of compiling per-worker Theano functions and
+  pairing them with a post-hoc exchanger.
+* ``train_iter`` consumes mesh-sharded device batches from a
+  double-buffered prefetcher and dispatches asynchronously; metrics
+  are fetched in windows (every ``print_freq`` iters) so the host
+  never serializes the device pipeline.
+* The reference's 'comm' recorder section is structurally zero here —
+  exchange is fused into 'calc' by design; the recorder keeps the
+  column for output parity.
+
+Subclasses define the network (a flax module taking ``(x, train)``),
+the dataset, and a config; everything else is inherited.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Iterator
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from theanompi_tpu.data.base import Dataset
+from theanompi_tpu.data.prefetch import DevicePrefetcher
+from theanompi_tpu.models.layers import (
+    error_rate,
+    softmax_cross_entropy,
+    topk_error,
+)
+from theanompi_tpu.parallel.bsp import (
+    TrainState,
+    make_bsp_eval_step,
+    make_bsp_train_step,
+)
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+from theanompi_tpu.parallel.mesh import data_axis_size, data_mesh, replicate
+from theanompi_tpu.utils.helper_funcs import (
+    load_params_npz,
+    save_params_npz,
+    scale_lr,
+    set_learning_rate,
+)
+from theanompi_tpu.utils.recorder import Recorder
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """One config dataclass per (model, rule) pair — SURVEY.md §5.6.
+
+    ``batch_size`` is PER data-shard (reference semantics: per-worker);
+    the global batch is ``batch_size * data_axis_size(mesh)``.
+    """
+
+    batch_size: int = 128
+    n_epochs: int = 70
+    learning_rate: float = 0.01
+    momentum: float = 0.9
+    nesterov: bool = False
+    weight_decay: float = 1e-4
+    lr_schedule: str = "step"              # 'step' | 'constant' | 'poly'
+    lr_decay_epochs: tuple = (40, 60)
+    lr_decay_factor: float = 0.1
+    lr_poly_power: float = 1.0
+    lr_scale_with_workers: str | None = None   # None | 'linear' | 'sqrt'
+    exchange_strategy: str = "psum"        # reference names accepted (nccl16...)
+    exchange_what: str = "grads"
+    compute_dtype: str = "float32"         # 'bfloat16' -> MXU-friendly compute
+    seed: int = 42
+    data_dir: str | None = None
+    snapshot_dir: str = "./snapshots"
+    print_freq: int = 40
+    track_top5: bool = False
+
+
+class TpuModel:
+    """Base model implementing the reference contract over the BSP spine."""
+
+    name = "model"
+
+    def __init__(self, config: ModelConfig | None = None, mesh=None,
+                 verbose: bool = True, shard_rank: int = 0,
+                 shard_size: int = 1):
+        self.config = config or self.default_config()
+        self.verbose = verbose
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self.n_workers = data_axis_size(self.mesh)
+        # async-rule data sharding: this model instance sees shard
+        # shard_rank of shard_size (BSP leaves these 0/1 — the mesh
+        # shards the global batch instead)
+        self.shard_rank = shard_rank
+        self.shard_size = shard_size
+        self.batch_size = self.config.batch_size
+        self.global_batch = self.batch_size * self.n_workers
+        self.n_epochs = self.config.n_epochs
+        self.current_epoch = 0
+        self.current_info: dict = {}
+
+        self.data: Dataset = self.build_data()
+        self.module: nn.Module = self.build_module()
+
+        base_lr = self.config.learning_rate
+        if self.config.lr_scale_with_workers:
+            base_lr = scale_lr(base_lr, self.n_workers,
+                               self.config.lr_scale_with_workers)
+        self._base_lr = base_lr
+
+        rng = jax.random.key(self.config.seed)
+        dummy = jnp.zeros((2, *self.data.sample_shape), self._input_dtype())
+        variables = self.module.init({"params": rng, "dropout": rng}, dummy,
+                                     train=False)
+        variables = dict(variables)
+        params = variables.pop("params")
+        model_state = variables  # e.g. {'batch_stats': ...} or {}
+
+        self.tx = self._build_optimizer(base_lr)
+        state = TrainState.create(params, self.tx, model_state)
+        self.state = replicate(state, self.mesh)
+
+        self._rng = jax.random.key(self.config.seed + 1)
+        self.train_step = None
+        self.eval_step = None
+        self._train_prefetcher: DevicePrefetcher | None = None
+        self._train_iter: Iterator | None = None
+        self._pending: list[tuple[int, dict]] = []
+
+    # -- hooks for subclasses ------------------------------------------------
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return ModelConfig()
+
+    def build_module(self) -> nn.Module:
+        raise NotImplementedError
+
+    def build_data(self) -> Dataset:
+        raise NotImplementedError
+
+    def _input_dtype(self):
+        return jnp.float32
+
+    # -- optimizer / loss ----------------------------------------------------
+
+    def _build_optimizer(self, lr: float) -> optax.GradientTransformation:
+        cfg = self.config
+
+        def make(learning_rate):
+            parts = []
+            if cfg.weight_decay:
+                parts.append(optax.add_decayed_weights(cfg.weight_decay))
+            parts.append(optax.sgd(learning_rate, momentum=cfg.momentum or None,
+                                   nesterov=cfg.nesterov))
+            return optax.chain(*parts)
+
+        return optax.inject_hyperparams(make)(learning_rate=lr)
+
+    def loss_fn(self, params, model_state, batch, rng):
+        """Default: softmax CE + top-1 error.  Override for GANs etc."""
+        x, y = batch
+        variables = {"params": params, **model_state}
+        mutable = [k for k in model_state if k == "batch_stats"]
+        if mutable:
+            logits, updates = self.module.apply(
+                variables, x, train=True, mutable=mutable,
+                rngs={"dropout": rng},
+            )
+            new_ms = {**model_state, **updates}
+        else:
+            logits = self.module.apply(variables, x, train=True,
+                                       rngs={"dropout": rng})
+            new_ms = model_state
+        if isinstance(logits, (tuple, list)):  # aux heads (GoogLeNet)
+            main, *aux = logits
+            loss = softmax_cross_entropy(main, y)
+            for a_logits, a_w in aux:
+                loss = loss + a_w * softmax_cross_entropy(a_logits, y)
+            logits = main
+        else:
+            loss = softmax_cross_entropy(logits, y)
+        metrics = {"loss": loss, "error": error_rate(logits, y)}
+        if self.config.track_top5:
+            metrics["top5_error"] = topk_error(logits, y, 5)
+        return loss, (new_ms, metrics)
+
+    def eval_fn(self, params, model_state, batch):
+        x, y = batch
+        variables = {"params": params, **model_state}
+        logits = self.module.apply(variables, x, train=False)
+        if isinstance(logits, (tuple, list)):
+            logits = logits[0]
+        metrics = {"loss": softmax_cross_entropy(logits, y),
+                   "error": error_rate(logits, y)}
+        if self.config.track_top5:
+            metrics["top5_error"] = topk_error(logits, y, 5)
+        return metrics
+
+    # -- reference contract --------------------------------------------------
+
+    @property
+    def params(self) -> PyTree:
+        return self.state.params
+
+    def compile_iter_fns(self, sync_type: str = "avg") -> None:
+        """Build the jitted SPMD steps (the reference's Theano-function
+        compile; ``sync_type`` 'avg' vs 'cdd' maps to exchange avg/sum)."""
+        exchanger = BSP_Exchanger(
+            strategy=self.config.exchange_strategy,
+            avg=(sync_type != "cdd"),
+            exchange_what=self.config.exchange_what,
+        )
+        self.train_step = make_bsp_train_step(self.loss_fn, self.tx,
+                                              self.mesh, exchanger)
+        self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh)
+
+    def compile_grad_fn(self):
+        """Jitted gradient-only step for parameter-server rules (ASGD):
+        returns ``fn(state, batch, rng) -> (grads, new_model_state,
+        metrics)`` with no optimizer update — the server applies it."""
+
+        def gstep(state: TrainState, batch, rng):
+            grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
+            (loss, (new_ms, metrics)), grads = grad_fn(
+                state.params, state.model_state, batch, rng)
+            metrics = dict(metrics)
+            metrics.setdefault("loss", loss)
+            return grads, new_ms, metrics
+
+        return jax.jit(gstep)
+
+    def begin_epoch(self, epoch: int) -> int:
+        """Stage the epoch's prefetched train iterator; returns n_iters."""
+        self.cleanup_iter()
+        self.current_epoch = epoch
+        host_iter = self.data.train_batches(epoch, self.global_batch,
+                                            self.shard_rank, self.shard_size)
+        self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh)
+        self._train_iter = iter(self._train_prefetcher)
+        return self.data.n_train_batches(self.global_batch * self.shard_size)
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def train_iter(self, count: int, recorder: Recorder) -> None:
+        if self.train_step is None:
+            raise RuntimeError("call compile_iter_fns() first")
+        recorder.start()
+        batch = next(self._train_iter)
+        recorder.end("wait")  # time blocked on the loader = reference 'wait'
+        recorder.start()
+        self.state, metrics = self.train_step(self.state, batch,
+                                              self._next_rng())
+        recorder.end("calc")  # async dispatch; device time lands on flush
+        self._pending.append((count, metrics))
+        if len(self._pending) >= max(recorder.print_freq, 1):
+            self._flush_metrics(recorder)
+            recorder.print_train_info(count)
+
+    def _flush_metrics(self, recorder: Recorder) -> None:
+        """Convert pending device metrics (blocks until the device has
+        caught up — charged to 'calc')."""
+        if not self._pending:
+            return
+        recorder.start()
+        for _, m in self._pending:
+            recorder.train_metrics(float(m["loss"]), float(m["error"]),
+                                   self.global_batch)
+        recorder.end("calc", block_on=self._pending[-1][1])
+        self._pending.clear()
+        self.current_info = {
+            "epoch": self.current_epoch,
+            "loss": recorder.train_losses[-1] if recorder.train_losses else None,
+        }
+
+    def val_iter(self, count: int, recorder: Recorder,
+                 batch=None) -> dict:
+        metrics = self.eval_step(self.state, batch)
+        return metrics
+
+    def val_epoch(self, recorder: Recorder) -> dict[str, float]:
+        """Full validation pass; returns averaged metrics."""
+        sums: dict[str, float] = {}
+        n = 0
+        host_iter = self.data.val_batches(self.global_batch)
+        with DevicePrefetcher(host_iter, self.mesh) as pf:
+            for batch in pf:
+                m = self.val_iter(n, recorder, batch)
+                for k, v in m.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                n += 1
+        return {k: v / max(n, 1) for k, v in sums.items()}
+
+    def adjust_hyperp(self, epoch: int) -> float:
+        """Per-epoch LR schedule (the reference's step/poly decay)."""
+        cfg = self.config
+        if cfg.lr_schedule == "constant":
+            lr = self._base_lr
+        elif cfg.lr_schedule == "step":
+            k = sum(1 for e in cfg.lr_decay_epochs if epoch >= e)
+            lr = self._base_lr * (cfg.lr_decay_factor ** k)
+        elif cfg.lr_schedule == "poly":
+            frac = min(epoch / max(cfg.n_epochs, 1), 1.0)
+            lr = self._base_lr * (1.0 - frac) ** cfg.lr_poly_power
+        else:
+            raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+        self.state = self.state.replace(
+            opt_state=set_learning_rate(self.state.opt_state, lr)
+        )
+        return lr
+
+    # -- persistence (npz param snapshots; full-state resume is Orbax in
+    #    the rules layer) ----------------------------------------------------
+
+    def save(self, path: str | None = None) -> str:
+        path = path or os.path.join(self.config.snapshot_dir,
+                                    f"{self.name}_params.npz")
+        save_params_npz(path, self.state.params)
+        return path
+
+    def load(self, path: str) -> None:
+        params = load_params_npz(path, jax.tree.map(np.asarray,
+                                                    self.state.params))
+        self.state = self.state.replace(
+            params=replicate(jax.tree.map(jnp.asarray, params), self.mesh)
+        )
+
+    def cleanup_iter(self) -> None:
+        if self._train_prefetcher is not None:
+            self._train_prefetcher.close()
+            self._train_prefetcher = None
+            self._train_iter = None
+
+    def cleanup(self) -> None:
+        self.cleanup_iter()

@@ -1,0 +1,68 @@
+"""Cifar10 CNN — the smoke-test model (bundled recipe #1: single-worker
+BSP, CPU-runnable; BASELINE.json configs[0]).
+
+Parity counterpart of the reference's ``theanompi/models/cifar10.py``
+(SURVEY.md §2.8 — mount empty, no file:line): a cuda-convnet-style
+small CNN — conv/pool stacks with LRN, two dense layers, softmax —
+SGD+momentum, step LR decay.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from theanompi_tpu.data.cifar10 import Cifar10_data
+from theanompi_tpu.models import layers as L
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+
+
+class Cifar10CNN(nn.Module):
+    n_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = L.Conv(32, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.max_pool(x, 3, 2)
+        x = L.LRN(n=3, k=1.0, alpha=5e-5, beta=0.75)(x)
+        x = L.Conv(32, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.avg_pool(x, 3, 2)
+        x = L.LRN(n=3, k=1.0, alpha=5e-5, beta=0.75)(x)
+        x = L.Conv(64, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.avg_pool(x, 3, 2)
+        x = x.reshape((x.shape[0], -1))
+        x = L.Dense(64, kernel_init=L.he_init())(x)
+        x = nn.relu(x)
+        x = L.Dense(self.n_classes, kernel_init=L.gaussian_init(0.01))(x)
+        return x.astype(jnp.float32)
+
+
+class Cifar10_model(TpuModel):
+    name = "cifar10"
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return ModelConfig(
+            batch_size=128,
+            n_epochs=70,
+            learning_rate=0.01,
+            momentum=0.9,
+            weight_decay=1e-4,
+            lr_schedule="step",
+            lr_decay_epochs=(50, 60),
+            lr_decay_factor=0.1,
+            print_freq=40,
+        )
+
+    def build_module(self) -> nn.Module:
+        dtype = jnp.bfloat16 if self.config.compute_dtype == "bfloat16" else jnp.float32
+        return Cifar10CNN(dtype=dtype)
+
+    def build_data(self):
+        return Cifar10_data(data_dir=self.config.data_dir,
+                            seed=self.config.seed)

@@ -1,0 +1,167 @@
+"""Shared layer library for the model zoo.
+
+Parity rebuild of the reference's ``theanompi/models/layers2.py``
+(SURVEY.md §2.8 — mount empty, no file:line): Conv (with channel
+grouping), pooling, LRN, BatchNorm, Dropout, FC, softmax head, plus
+the era-appropriate weight initializers.  Built on flax.linen; the
+grouped convolution that the reference routed to cuDNN groups maps to
+XLA's ``feature_group_count``, and LRN is composed from XLA ops
+(theanompi_tpu.ops.lrn).
+
+Everything is NHWC and defaults to float32 params with configurable
+compute dtype — pass ``dtype=jnp.bfloat16`` to run the matmul/conv
+FLOPs on the MXU in bf16 while keeping fp32 master params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops.lrn import lrn
+
+Dtype = Any
+
+# -- reference-era initializers (gaussian std + constant bias) --
+
+
+def gaussian_init(std: float = 0.01):
+    def init(key, shape, dtype=jnp.float32):
+        return std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def constant_init(v: float = 0.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, v, dtype)
+    return init
+
+
+he_init = nn.initializers.he_normal
+xavier_init = nn.initializers.xavier_uniform
+
+
+class Conv(nn.Module):
+    """Convolution with optional channel grouping + LRN + pooling —
+    mirroring the reference's fused ConvPoolLRN layer blocks."""
+
+    features: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: str | Sequence[tuple[int, int]] = "SAME"
+    groups: int = 1
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.he_normal()
+    bias_init: Callable = constant_init(0.0)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(
+            features=self.features,
+            kernel_size=self.kernel,
+            strides=self.strides,
+            padding=self.padding,
+            feature_group_count=self.groups,
+            use_bias=self.use_bias,
+            kernel_init=self.kernel_init,
+            bias_init=self.bias_init,
+            dtype=self.dtype,
+        )(x)
+
+
+def max_pool(x, window: int = 3, stride: int = 2, padding="VALID"):
+    return nn.max_pool(x, (window, window), (stride, stride), padding)
+
+
+def avg_pool(x, window: int = 3, stride: int = 2, padding="VALID"):
+    return nn.avg_pool(x, (window, window), (stride, stride), padding)
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+class LRN(nn.Module):
+    """Cross-channel local response normalization (AlexNet/GoogLeNet)."""
+
+    n: int = 5
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    @nn.compact
+    def __call__(self, x):
+        return lrn(x, self.n, self.k, self.alpha, self.beta)
+
+
+class BatchNorm(nn.Module):
+    """BN with the running stats in the 'batch_stats' collection.
+
+    Cross-replica note: per-shard batch stats are averaged over the
+    data axis by the BSP step (parallel/bsp.py pmean of model_state),
+    which matches the reference's per-worker BN closely enough while
+    keeping state replicated."""
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.BatchNorm(
+            use_running_average=self.use_running_average,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            dtype=self.dtype,
+        )(x)
+
+
+class Dense(nn.Module):
+    features: int
+    kernel_init: Callable = gaussian_init(0.005)
+    bias_init: Callable = constant_init(0.0)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.features,
+            kernel_init=self.kernel_init,
+            bias_init=self.bias_init,
+            dtype=self.dtype,
+        )(x)
+
+
+class Dropout(nn.Module):
+    rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        return nn.Dropout(self.rate, deterministic=not train)(x)
+
+
+# -- loss / metric heads (the reference's softmax layer + error calc) --
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over the batch; labels are integer class ids."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=1)
+    return -jnp.mean(ll)
+
+
+def error_rate(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Top-1 error (the reference's per-iteration 'error')."""
+    return jnp.mean((jnp.argmax(logits, axis=-1) != labels).astype(jnp.float32))
+
+
+def topk_error(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
+    """Top-k error (the reference tracked top-5 for ImageNet)."""
+    topk = jax.lax.top_k(logits, k)[1]
+    hit = jnp.any(topk == labels[:, None], axis=-1)
+    return 1.0 - jnp.mean(hit.astype(jnp.float32))

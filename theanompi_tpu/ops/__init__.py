@@ -1,0 +1,3 @@
+from theanompi_tpu.ops.lrn import lrn
+
+__all__ = ["lrn"]

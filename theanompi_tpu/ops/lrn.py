@@ -1,0 +1,48 @@
+"""Local Response Normalization (AlexNet-era, across channels).
+
+The reference got LRN from cuDNN via Theano's dnn ops (layer library
+``theanompi/models/layers2.py``, SURVEY.md §2.8 — mount empty, no
+file:line).  On TPU there is no library kernel to call; this composes
+XLA ops — ``reduce_window`` over the channel axis — which XLA fuses
+into the surrounding elementwise work.  Benchmarked as a tiny fraction
+of AlexNet step time, so a Pallas kernel is not warranted (SURVEY.md
+§2.12 note: Pallas only if profiling demands).
+
+y = x / (k + alpha/n * sum_{j in window(n)} x_j^2)^beta
+(matching cuDNN/Caffe LRN, where alpha is divided by the window size;
+set ``alpha_scaled_by_n=False`` for the raw AlexNet-paper variant that
+uses alpha directly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def lrn(
+    x: jax.Array,
+    n: int = 5,
+    k: float = 2.0,
+    alpha: float = 1e-4,
+    beta: float = 0.75,
+    *,
+    alpha_scaled_by_n: bool = True,
+) -> jax.Array:
+    """Cross-channel LRN for NHWC input."""
+    if x.ndim != 4:
+        raise ValueError(f"lrn expects NHWC, got shape {x.shape}")
+    sq = x * x
+    # windowed sum over channel dim, same-padded.  n is tiny (3-5), so a
+    # sum of n shifted slices beats reduce_window (and is trivially
+    # differentiable); XLA fuses it into the surrounding elementwise ops.
+    lo = (n - 1) // 2
+    hi = n - 1 - lo
+    c = x.shape[-1]
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (lo, hi)))
+    win = padded[..., 0:c]
+    for d in range(1, n):
+        win = win + padded[..., d:d + c]
+    a = alpha / n if alpha_scaled_by_n else alpha
+    return x * (k + a * win) ** (-beta)

@@ -1,0 +1,171 @@
+"""Host-side parameter services for the asynchronous rules.
+
+The reference ran EASGD/ASGD servers as dedicated MPI ranks owning a
+GPU, serializing worker exchanges through a probe/recv message loop
+(SURVEY.md §2.3, §3.3 — mount empty, no file:line), and GOSGD used
+point-to-point MPI sends to random peers.
+
+TPU-native redesign: the server is not a device-owning process — it is
+a thread-safe store on the controller host.  Worker<->server traffic is
+XLA host<->device transfer (the ``[driver]`` north-star: elastic copies
+move from GPUDirect/mpi4py to host<->device transfers); in multi-host
+deployments the same store sits behind the launcher's host process and
+traffic rides DCN.  The merge arithmetic itself
+(``easgd_both_updates``, optax server updates, ``gosgd_merge``) runs
+jitted on the worker's own device — the host only holds and swaps
+buffers.
+
+The lock serializes center access exactly like the reference's server
+loop did; the known serialization bottleneck (SURVEY.md §3.3) is
+mitigated by keeping the critical section to a device dispatch (the
+elastic update is async-dispatched; the lock is released before the
+result is fetched).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+import optax
+
+from theanompi_tpu.parallel.exchanger import easgd_both_updates
+
+PyTree = Any
+
+
+def _is_host(tree: PyTree) -> bool:
+    leaves = jax.tree.leaves(tree)
+    return not leaves or isinstance(leaves[0], np.ndarray)
+
+
+class EASGDServer:
+    """Center-parameter store with the elastic-averaging exchange."""
+
+    def __init__(self, params: PyTree, alpha: float = 0.5):
+        self.alpha = alpha
+        self._center = jax.tree.map(np.asarray, params)
+        self._lock = threading.Lock()
+        self.n_exchanges = 0
+
+    def exchange(self, worker_params: PyTree) -> PyTree:
+        """One elastic exchange; returns the worker's new params.
+
+        worker <- worker - a(worker - center); center <- center + a(worker - center)
+
+        The lock covers fetching the previous center value and
+        dispatching the fused update — NOT the update's device
+        execution (dispatch is async) nor the caller's use of its new
+        params.  The unavoidable serialization is the fetch: exchange
+        k+1 must see exchange k's center, so it blocks until k's device
+        work finishes — but worker k keeps training in the meantime.
+        """
+        with self._lock:
+            # prior center may be an un-fetched device array committed to
+            # another worker's device; materialize on host so this
+            # worker's jit doesn't see mixed devices
+            center = self._center
+            if not _is_host(center):
+                center = jax.device_get(center)
+            new_w, new_c = easgd_both_updates(worker_params, center,
+                                              self.alpha)
+            self._center = new_c  # lazily fetched by the next exchange
+            self.n_exchanges += 1
+        return new_w
+
+    def get_center(self) -> PyTree:
+        with self._lock:
+            return jax.device_get(self._center)
+
+
+class ASGDServer:
+    """Classic async parameter server: workers push grads, server
+    applies its optimizer to the center and returns fresh params."""
+
+    def __init__(self, params: PyTree,
+                 tx: optax.GradientTransformation):
+        self._center = params
+        self.tx = tx
+        self._opt_state = tx.init(params)
+        self._lock = threading.Lock()
+        self.n_updates = 0
+
+        @jax.jit
+        def _apply(params, opt_state, grads):
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self._apply = _apply
+
+    def set_lr(self, lr: float) -> None:
+        """Apply the per-epoch LR schedule to the SERVER's optimizer —
+        the one that actually applies updates (workers' own opt_states
+        are unused in ASGD).  Requires inject_hyperparams (which the
+        TpuModel optimizer builder always uses)."""
+        from theanompi_tpu.utils.helper_funcs import set_learning_rate
+
+        with self._lock:
+            self._opt_state = set_learning_rate(self._opt_state, lr)
+
+    def push_pull(self, grads: PyTree) -> PyTree:
+        """Apply worker grads to the center; return fresh center params
+        (host arrays — the caller places them on its own device).
+
+        Grads are fetched to host first: workers live on different
+        devices, and the center is committed to the server's device
+        (the reference's server owned its own GPU the same way)."""
+        host_grads = jax.device_get(grads)
+        with self._lock:
+            self._center, self._opt_state = self._apply(
+                self._center, self._opt_state, host_grads)
+            self.n_updates += 1
+            center = self._center
+        return jax.device_get(center)
+
+    def get_center(self) -> PyTree:
+        with self._lock:
+            return self._center
+
+
+class GossipHub:
+    """Rendezvous for GOSGD's point-to-point pushes (the TPU stand-in
+    for the reference's random-peer MPI sends).  Each worker has an
+    inbox; senders never block."""
+
+    def __init__(self, n_workers: int, maxsize: int = 64):
+        self.n_workers = n_workers
+        self._inboxes = [queue.Queue(maxsize=maxsize) for _ in range(n_workers)]
+        self._active = [True] * n_workers
+
+    def push(self, dst: int, params: PyTree, weight: float) -> bool:
+        """Deliver (params, weight) to worker ``dst``; False if refused.
+
+        A refused push costs the sender nothing — it keeps its weight.
+        Pushes to deactivated (finished) workers are refused, otherwise
+        stragglers would bleed gossip weight into inboxes nobody drains
+        (breaking the sum-of-weights≈1 conservation invariant)."""
+        if not self._active[dst]:
+            return False
+        payload = (jax.tree.map(np.asarray, params), float(weight))
+        try:
+            self._inboxes[dst].put_nowait(payload)
+            return True
+        except queue.Full:
+            return False
+
+    def deactivate(self, rank: int) -> None:
+        """Mark ``rank`` finished; peers stop pushing to it."""
+        self._active[rank] = False
+
+    def drain(self, rank: int) -> list[tuple[PyTree, float]]:
+        """All pending deliveries for worker ``rank`` (non-blocking)."""
+        out = []
+        q = self._inboxes[rank]
+        while True:
+            try:
+                out.append(q.get_nowait())
+            except queue.Empty:
+                return out

@@ -1,0 +1,14 @@
+from theanompi_tpu.rules.base import Rule, resolve_devices, resolve_model_class
+from theanompi_tpu.rules.bsp import BSP, run_bsp_session
+
+__all__ = ["Rule", "BSP", "EASGD", "ASGD", "GOSGD",
+           "run_bsp_session", "resolve_devices", "resolve_model_class"]
+
+
+def __getattr__(name):
+    # Async rules import lazily (they pull in the server/actor stack).
+    if name in ("EASGD", "ASGD", "GOSGD"):
+        from theanompi_tpu.rules import async_rules
+
+        return getattr(async_rules, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,349 @@
+"""Asynchronous training rules: EASGD, ASGD, GOSGD.
+
+Parity rebuild of the reference's async worker/server processes
+(SURVEY.md §2.3, §2.5, §3.3 — mount empty, no file:line):
+
+* EASGD (Zhang et al.): a server holds *center* params; each worker
+  trains tau local iterations then does an elastic exchange
+  (worker -= a(worker-center); center += a(worker-center)).
+* ASGD: classic parameter server — workers push grads, the server
+  applies its optimizer and returns fresh params.
+* GOSGD (Blot et al.): no server; each worker keeps (params, weight)
+  and, with probability p per iteration, halves its weight and sends
+  (params, weight/2) to a uniformly-random peer, which merges by
+  weighted average.
+
+TPU-native redesign: the reference's one-MPI-rank-per-GPU topology
+becomes one *worker thread per device (or device subset)* inside the
+controller process, each running its own jitted step on its own
+sub-mesh; server state lives on the host (parallel/server.py) and
+parameter traffic is XLA host<->device transfer.  Each worker trains
+on its own data shard (``shard_rank``/``shard_size``), like the
+reference's per-rank shard lists.  Failure semantics stay fail-fast:
+any worker exception aborts the session (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.parallel.exchanger import gosgd_merge
+from theanompi_tpu.parallel.mesh import data_mesh, replicate
+from theanompi_tpu.parallel.server import ASGDServer, EASGDServer, GossipHub
+from theanompi_tpu.rules.base import Rule, resolve_model_class
+from theanompi_tpu.utils.checkpoint import Checkpointer
+from theanompi_tpu.utils.recorder import Recorder
+
+PyTree = Any
+
+
+class _AsyncRule(Rule):
+    """Shared scaffolding: N worker threads, one model per device."""
+
+    def _build_workers(self, devs, modelfile, modelclass, config, **kwargs):
+        cls = resolve_model_class(modelfile, modelclass)
+        models = []
+        for i, dev in enumerate(devs):
+            m = cls(config=config, mesh=data_mesh(1, [dev]),
+                    shard_rank=i, shard_size=len(devs), **kwargs)
+            models.append(m)
+        return models
+
+    def _run_worker_threads(self, targets):
+        errors: list[BaseException] = []
+        abort = threading.Event()
+
+        def wrap(fn, rank):
+            def run():
+                try:
+                    fn(abort)
+                except BaseException as e:
+                    errors.append(e)
+                    abort.set()
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"{self.name}-worker{rank}")
+            return t
+
+        threads = [wrap(fn, i) for i, fn in enumerate(targets)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+
+class EASGD(_AsyncRule):
+    """Elastic-averaging SGD (reference rule #2)."""
+
+    name = "EASGD"
+
+    def _session(self, devs, modelfile, modelclass, config, resume,
+                 sync_type, tau: int = 10, alpha: float = 0.5,
+                 max_epochs: int | None = None, checkpoint: bool = True,
+                 **kwargs):
+        models = self._build_workers(devs, modelfile, modelclass, config,
+                                     **kwargs)
+        self.model = models[0]
+        cfg = self.model.config
+
+        ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, self.model.name)) \
+            if checkpoint else None
+        start_epoch = 0
+        if resume:
+            if ckpt is None:
+                raise ValueError("resume=True requires checkpoint=True")
+            latest = ckpt.latest_epoch()
+            if latest is not None:
+                payload = ckpt.restore(latest, like={
+                    "state": models[0].state, "epoch": 0})
+                start_epoch = int(payload["epoch"]) + 1
+                center0 = jax.device_get(payload["state"].params)
+                for m in models:
+                    m.state = m.state.replace(
+                        params=replicate(center0, m.mesh))
+                    m.adjust_hyperp(start_epoch)
+        server = EASGDServer(models[0].state.params, alpha=alpha)
+        self.server = server
+        n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
+                                                               start_epoch + max_epochs)
+        recorders = [Recorder(rank=i, size=len(devs),
+                              print_freq=cfg.print_freq)
+                     for i in range(len(models))]
+        epoch_done = threading.Semaphore(0)
+
+        def make_worker(rank: int):
+            model, recorder = models[rank], recorders[rank]
+
+            def work(abort: threading.Event):
+                model.compile_iter_fns("avg")
+                it_total = 0
+                for epoch in range(start_epoch, n_epochs):
+                    n_iters = model.begin_epoch(epoch)
+                    for it in range(n_iters):
+                        if abort.is_set():
+                            return
+                        if it_total % tau == 0:
+                            recorder.start()
+                            new_params = server.exchange(model.state.params)
+                            model.state = model.state.replace(
+                                params=new_params)
+                            recorder.end("comm")
+                        model.train_iter(it, recorder)
+                        it_total += 1
+                    model._flush_metrics(recorder)
+                    model.adjust_hyperp(epoch + 1)
+                    if rank == 0:
+                        epoch_done.release()
+                # final elastic sync so worker state ~ center
+                model.state = model.state.replace(
+                    params=server.exchange(model.state.params))
+                model.cleanup()
+
+            return work
+
+        # Server-side orchestration (validation + checkpoint per epoch of
+        # worker 0 — the reference's server orchestrated validation too).
+        # Owns its own model instance: worker 0's state is being mutated
+        # concurrently by its thread.
+        val_model = resolve_model_class(modelfile, modelclass)(
+            config=config, mesh=data_mesh(1, [devs[0]]), **kwargs)
+        val_model.compile_iter_fns("avg")
+        # rank 0 so the per-epoch summary prints; worker recorders are
+        # never touched from this thread
+        val_recorder = Recorder(rank=0, size=len(devs),
+                                print_freq=cfg.print_freq)
+        val_results: list[dict] = []
+
+        def orchestrate(abort: threading.Event):
+            for epoch in range(start_epoch, n_epochs):
+                while not epoch_done.acquire(timeout=0.5):
+                    if abort.is_set():
+                        return
+                center = jax.tree.map(np.asarray, server.get_center())
+                val_model.state = val_model.state.replace(
+                    params=replicate(center, val_model.mesh))
+                val = val_model.val_epoch(val_recorder)
+                val_results.append(val)
+                if ckpt is not None:
+                    ckpt.save(epoch, {"state": val_model.state,
+                                      "epoch": epoch})
+                val_recorder.epoch_summary(epoch, val.get("loss"),
+                                           val.get("error"))
+
+        self._run_worker_threads(
+            [make_worker(i) for i in range(len(models))] + [orchestrate])
+        if ckpt is not None:
+            ckpt.close()
+        self.result = {
+            "val": val_results[-1] if val_results else {},
+            "val_curve": val_results,
+            "n_exchanges": server.n_exchanges,
+            "center": server.get_center(),
+        }
+
+
+class ASGD(_AsyncRule):
+    """Async parameter server (reference rule #3)."""
+
+    name = "ASGD"
+
+    def _session(self, devs, modelfile, modelclass, config, resume,
+                 sync_type, max_epochs: int | None = None,
+                 checkpoint: bool = True, **kwargs):
+        if resume:
+            raise NotImplementedError(
+                "ASGD resume is not implemented yet; restart from scratch "
+                "or use BSP/EASGD which support --resume")
+        models = self._build_workers(devs, modelfile, modelclass, config,
+                                     **kwargs)
+        self.model = models[0]
+        cfg = self.model.config
+        server = ASGDServer(models[0].state.params, models[0].tx)
+        self.server = server
+        n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
+                                                               max_epochs)
+        recorders = [Recorder(rank=i, size=len(devs),
+                              print_freq=cfg.print_freq)
+                     for i in range(len(models))]
+
+        def make_worker(rank: int):
+            model, recorder = models[rank], recorders[rank]
+
+            def work(abort: threading.Event):
+                gstep = model.compile_grad_fn()
+                for epoch in range(n_epochs):
+                    n_iters = model.begin_epoch(epoch)
+                    for it in range(n_iters):
+                        if abort.is_set():
+                            return
+                        recorder.start()
+                        batch = next(model._train_iter)
+                        recorder.end("wait")
+                        recorder.start()
+                        grads, new_ms, metrics = gstep(model.state, batch,
+                                                       model._next_rng())
+                        recorder.end("calc", block_on=metrics)
+                        recorder.start()
+                        fresh = server.push_pull(grads)
+                        model.state = model.state.replace(
+                            params=replicate(fresh, model.mesh),
+                            model_state=new_ms)
+                        recorder.end("comm")
+                        recorder.train_metrics(float(metrics["loss"]),
+                                               float(metrics["error"]),
+                                               model.global_batch)
+                    new_lr = model.adjust_hyperp(epoch + 1)
+                    if rank == 0:
+                        # the server's optimizer applies the updates, so
+                        # the schedule must reach IT (workers' own
+                        # opt_states are unused under ASGD)
+                        server.set_lr(new_lr)
+                model.cleanup()
+
+            return work
+
+        self._run_worker_threads([make_worker(i) for i in range(len(models))])
+        center = jax.device_get(server.get_center())
+        probe = models[0]
+        probe.compile_iter_fns("avg")
+        probe.state = probe.state.replace(params=replicate(center, probe.mesh))
+        val = probe.val_epoch(recorders[0])
+        self.result = {"val": val, "n_updates": server.n_updates,
+                       "center": center}
+
+
+class GOSGD(_AsyncRule):
+    """Decentralized gossip SGD (reference rule #4)."""
+
+    name = "GOSGD"
+
+    def _session(self, devs, modelfile, modelclass, config, resume,
+                 sync_type, p_push: float = 0.1,
+                 max_epochs: int | None = None, **kwargs):
+        if resume:
+            raise NotImplementedError(
+                "GOSGD resume is not implemented yet; restart from scratch "
+                "or use BSP/EASGD which support --resume")
+        models = self._build_workers(devs, modelfile, modelclass, config,
+                                     **kwargs)
+        self.model = models[0]
+        cfg = self.model.config
+        n = len(models)
+        hub = GossipHub(n)
+        n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
+                                                               max_epochs)
+        recorders = [Recorder(rank=i, size=n, print_freq=cfg.print_freq)
+                     for i in range(n)]
+        weights = [1.0 / n] * n  # gossip weights, renormalized by merges
+
+        def make_worker(rank: int):
+            model, recorder = models[rank], recorders[rank]
+            rng = np.random.default_rng(cfg.seed + 31 * rank)
+
+            def work(abort: threading.Event):
+                model.compile_iter_fns("avg")
+                for epoch in range(n_epochs):
+                    n_iters = model.begin_epoch(epoch)
+                    for it in range(n_iters):
+                        if abort.is_set():
+                            return
+                        # merge anything gossiped to us
+                        recorder.start()
+                        for recv_params, recv_w in hub.drain(rank):
+                            merged, new_w = gosgd_merge(
+                                model.state.params, weights[rank],
+                                recv_params, recv_w)
+                            model.state = model.state.replace(params=merged)
+                            weights[rank] = float(new_w)
+                        recorder.end("comm")
+                        model.train_iter(it, recorder)
+                        # push with probability p to a random peer
+                        if n > 1 and rng.random() < p_push:
+                            dst = int(rng.integers(0, n - 1))
+                            dst = dst if dst < rank else dst + 1
+                            recorder.start()
+                            half = weights[rank] / 2.0
+                            if hub.push(dst, model.state.params, half):
+                                weights[rank] = half
+                            recorder.end("comm")
+                    model._flush_metrics(recorder)
+                    model.adjust_hyperp(epoch + 1)
+                hub.deactivate(rank)
+                model.cleanup()
+
+            return work
+
+        self._run_worker_threads([make_worker(i) for i in range(n)])
+        # merge whatever was still in flight at shutdown (conserves the
+        # gossip weight), then fold the weighted consensus
+        for rank in range(n):
+            for recv_params, recv_w in hub.drain(rank):
+                merged, new_w = gosgd_merge(
+                    jax.device_get(models[rank].state.params), weights[rank],
+                    recv_params, recv_w)
+                models[rank].state = models[rank].state.replace(
+                    params=replicate(jax.device_get(merged),
+                                     models[rank].mesh))
+                weights[rank] = float(new_w)
+        # consensus = weight-averaged params across workers (fetched to
+        # host first — each worker's params are committed to its device)
+        consensus = jax.device_get(models[0].state.params)
+        acc_w = weights[0]
+        for i in range(1, n):
+            consensus, acc_w = gosgd_merge(
+                consensus, acc_w, jax.device_get(models[i].state.params),
+                weights[i])
+        probe = models[0]
+        probe.compile_iter_fns("avg")
+        probe.state = probe.state.replace(
+            params=replicate(jax.device_get(consensus), probe.mesh))
+        val = probe.val_epoch(recorders[0])
+        self.result = {"val": val, "weights": weights,
+                       "consensus": jax.tree.map(np.asarray, consensus)}

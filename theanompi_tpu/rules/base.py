@@ -1,0 +1,124 @@
+"""Rule API — the user-facing training-rule objects.
+
+Parity surface of the reference's rule classes in
+``theanompi/__init__.py`` (SURVEY.md §2.2 — mount empty, no file:line):
+
+    rule = BSP()
+    rule.init(devices=..., modelfile='...', modelclass='...')
+    rule.wait()
+
+TPU-native inversion: the reference's ``init`` synthesized an
+``mpirun`` command and spawned N OS processes (one per GPU).  Here a
+rule builds a device mesh inside THIS process and runs its training
+session on a background thread — ``wait()`` joins it and re-raises any
+failure (fail-fast, matching the reference's a-dead-rank-kills-the-job
+behavior, SURVEY.md §5.3).  Multi-host launch (one process per host,
+``jax.distributed``) is the launcher's job, not the rule's.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import traceback
+from typing import Any, Sequence
+
+import jax
+
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+from theanompi_tpu.parallel.mesh import data_mesh
+
+
+def resolve_model_class(modelfile: str, modelclass: str) -> type:
+    """Import ``modelclass`` from module path ``modelfile`` (the
+    reference's modelfile/modelclass convention, SURVEY.md §2.1)."""
+    try:
+        mod = importlib.import_module(modelfile)
+    except ModuleNotFoundError as e:
+        from theanompi_tpu.models import MODEL_ZOO
+
+        raise ModuleNotFoundError(
+            f"model module {modelfile!r} not found; available zoo models: "
+            f"{', '.join(sorted(MODEL_ZOO))}"
+        ) from e
+    try:
+        return getattr(mod, modelclass)
+    except AttributeError as e:
+        raise AttributeError(
+            f"module {modelfile!r} has no class {modelclass!r}"
+        ) from e
+
+
+def resolve_devices(devices: int | Sequence | None) -> list:
+    """Accept None (all), an int count, device indices, or jax Devices."""
+    all_devs = jax.devices()
+    if devices is None:
+        return list(all_devs)
+    if isinstance(devices, int):
+        if devices > len(all_devs):
+            raise ValueError(
+                f"requested {devices} devices, have {len(all_devs)}"
+            )
+        return list(all_devs)[:devices]
+    out = []
+    for d in devices:
+        if isinstance(d, int):
+            out.append(all_devs[d])
+        elif isinstance(d, str):
+            # reference-style 'cuda0' strings: keep the index, ignore the
+            # prefix — devices are whatever the platform provides
+            idx = int("".join(ch for ch in d if ch.isdigit()) or 0)
+            out.append(all_devs[idx])
+        else:
+            out.append(d)
+    return out
+
+
+class Rule:
+    """Base: owns session thread + error propagation."""
+
+    name = "rule"
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self.model: TpuModel | None = None
+        self.result: dict[str, Any] = {}
+
+    def init(self, devices=None, modelfile: str = "theanompi_tpu.models.cifar10",
+             modelclass: str = "Cifar10_model",
+             config: ModelConfig | None = None,
+             resume: bool = False, sync_type: str = "avg",
+             **kwargs) -> "Rule":
+        devs = resolve_devices(devices)
+        self._start(devs, modelfile, modelclass, config, resume, sync_type,
+                    **kwargs)
+        return self
+
+    def wait(self) -> dict[str, Any]:
+        if self._thread is None:
+            raise RuntimeError("call init() before wait()")
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        return self.result
+
+    # -- internals --
+
+    def _start(self, devs, modelfile, modelclass, config, resume, sync_type,
+               **kwargs):
+        def run():
+            try:
+                self._session(devs, modelfile, modelclass, config, resume,
+                              sync_type, **kwargs)
+            except BaseException as e:  # propagated by wait()
+                traceback.print_exc()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name=f"{self.name}-session")
+        self._thread.start()
+
+    def _session(self, devs, modelfile, modelclass, config, resume,
+                 sync_type, **kwargs):
+        raise NotImplementedError

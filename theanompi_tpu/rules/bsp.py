@@ -1,0 +1,85 @@
+"""BSP — synchronous data-parallel training.
+
+Parity rebuild of the reference's BSP worker process (SURVEY.md §2.3,
+§3.2 — mount empty, no file:line): per-iteration train step +
+gradient allreduce, per-epoch validation, ``adjust_hyperp``, rank-0
+checkpoint.  Here the N worker processes collapse into one SPMD
+program over the mesh's ``data`` axis; the exchange is fused into the
+jitted step (parallel/bsp.py), so this module is just the epoch
+driver: data staging, validation, LR schedule, checkpoint/resume,
+recorder bookkeeping.
+"""
+
+from __future__ import annotations
+
+import os
+
+from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.parallel.mesh import data_mesh
+from theanompi_tpu.rules.base import Rule, resolve_model_class
+from theanompi_tpu.utils.checkpoint import Checkpointer
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def run_bsp_session(model: TpuModel, sync_type: str = "avg",
+                    resume: bool = False, recorder: Recorder | None = None,
+                    max_epochs: int | None = None,
+                    checkpoint: bool = True) -> dict:
+    """The BSP epoch loop (callable directly, e.g. from the launcher)."""
+    cfg = model.config
+    recorder = recorder or Recorder(rank=0, size=model.n_workers,
+                                    print_freq=cfg.print_freq,
+                                    save_dir=cfg.snapshot_dir)
+    model.compile_iter_fns(sync_type)
+
+    ckpt = None
+    start_epoch = 0
+    if checkpoint:
+        ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, model.name))
+        if resume:
+            latest = ckpt.latest_epoch()
+            if latest is not None:
+                payload = ckpt.restore(latest, like={
+                    "state": model.state, "epoch": 0})
+                model.state = payload["state"]
+                start_epoch = int(payload["epoch"]) + 1
+                recorder.load(cfg.snapshot_dir)
+                # fast-forward the LR schedule (reference resume semantics)
+                model.adjust_hyperp(start_epoch)
+
+    n_epochs = model.n_epochs if max_epochs is None else min(
+        model.n_epochs, start_epoch + max_epochs)
+    last_val: dict = {}
+    for epoch in range(start_epoch, n_epochs):
+        n_iters = model.begin_epoch(epoch)
+        for it in range(n_iters):
+            model.train_iter(it, recorder)
+        model._flush_metrics(recorder)
+        recorder.start()
+        last_val = model.val_epoch(recorder)
+        recorder.end("calc")
+        model.adjust_hyperp(epoch + 1)
+        if ckpt is not None:
+            ckpt.save(epoch, {"state": model.state, "epoch": epoch})
+        recorder.epoch_summary(epoch, last_val.get("loss"),
+                               last_val.get("error"))
+    model.cleanup()
+    if ckpt is not None:
+        ckpt.close()
+    return {"val": last_val, "epochs_run": n_epochs - start_epoch,
+            "records": recorder.epoch_records}
+
+
+class BSP(Rule):
+    """Synchronous BSP data-parallel rule (reference rule #1)."""
+
+    name = "BSP"
+
+    def _session(self, devs, modelfile, modelclass, config, resume,
+                 sync_type, max_epochs=None, checkpoint=True, **kwargs):
+        mesh = data_mesh(len(devs), devs)
+        cls = resolve_model_class(modelfile, modelclass)
+        self.model = cls(config=config, mesh=mesh, **kwargs)
+        self.result = run_bsp_session(self.model, sync_type=sync_type,
+                                      resume=resume, max_epochs=max_epochs,
+                                      checkpoint=checkpoint)

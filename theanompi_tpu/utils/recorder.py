@@ -77,7 +77,9 @@ class Recorder:
         self.n_images += int(n_images)
 
     def print_train_info(self, it: int) -> None:
-        if self.rank != 0 or self.print_freq <= 0 or it % self.print_freq != 0:
+        # cadence is the caller's business (models flush pending device
+        # metrics every print_freq iterations and then call this)
+        if self.rank != 0 or self.print_freq <= 0:
             return
         window = self.train_losses[-self.print_freq:]
         werr = self.train_errors[-self.print_freq:]
