@@ -1,0 +1,91 @@
+"""Benchmark: ResNet-50 ImageNet BSP training throughput (the driver's
+primary metric — BASELINE.json: images/sec/chip, north-star ≥2500
+img/s on a v5e-16 ⇒ 156.25 img/s/chip).
+
+Runs the flagship BSP training step (fwd + bwd + psum exchange + SGD
+update, bf16 compute) on all available devices with synthetic
+ImageNet-shaped data pre-staged on device (measures the device step,
+which is what images/sec/chip compares; the input pipeline is
+benchmarked by its own tests).  Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_PER_CHIP = 2500.0 / 16.0  # north-star v5e-16 target, per chip
+
+
+def main() -> None:
+    from theanompi_tpu.models.base import ModelConfig
+    from theanompi_tpu.models.resnet50 import ResNet50
+    from theanompi_tpu.data.imagenet import ImageNet_data
+    from theanompi_tpu.parallel.mesh import data_mesh, shard_batch
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = data_mesh(n_chips, devices)
+
+    batch_per_chip = 128
+    global_batch = batch_per_chip * n_chips
+
+    class BenchResNet50(ResNet50):
+        def build_data(self):
+            return ImageNet_data(crop=224, synthetic_n=global_batch * 64,
+                                 synthetic_pool=64, synthetic_store=256)
+
+    cfg = ModelConfig(batch_size=batch_per_chip, n_epochs=1,
+                      compute_dtype="bfloat16", track_top5=False,
+                      print_freq=10**9)
+    model = BenchResNet50(config=cfg, mesh=mesh, verbose=False)
+    model.compile_iter_fns("avg")
+
+    # Pre-stage a few device batches and cycle them (device-step
+    # throughput; keeps host augment out of the timed region).
+    host_it = model.data.train_batches(0, global_batch)
+    staged = [shard_batch(next(host_it), mesh) for _ in range(4)]
+
+    rng = jax.random.key(0)
+    state = model.state
+
+    # warmup (compile + steady state); sync via value readback — the
+    # experimental axon plugin's block_until_ready returns early, so a
+    # host transfer is the only reliable fence.
+    for i in range(3):
+        state, metrics = model.train_step(state, staged[i % len(staged)], rng)
+    float(metrics["loss"])
+
+    n_steps = 30
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state, metrics = model.train_step(state, staged[i % len(staged)], rng)
+    loss = float(metrics["loss"])  # fences the whole chain
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    images_per_sec = n_steps * global_batch / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_imagenet_bsp_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_PER_CHIP, 4),
+        "detail": {
+            "n_chips": n_chips,
+            "global_batch": global_batch,
+            "images_per_sec_total": round(images_per_sec, 2),
+            "step_ms": round(dt / n_steps * 1e3, 2),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

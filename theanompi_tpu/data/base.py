@@ -56,6 +56,17 @@ class Dataset(abc.ABC):
 
         return divide_batches(self.n_train, global_batch)
 
+    def n_train_batches_for(self, epoch: int, global_batch: int,
+                            rank: int = 0, size: int = 1) -> int:
+        """EXACT number of batches ``train_batches(epoch, global_batch,
+        rank, size)`` will yield.  Ranks' shards need not be equal
+        (file-list sharding gives unequal sample counts), so training
+        loops must size their iteration count with this, not with a
+        global ``n_train / size`` estimate."""
+        # default matches the index-sharding scheme (order[rank::size])
+        n_mine = (self.n_train - rank + size - 1) // size
+        return n_mine // global_batch
+
     def n_val_batches(self, global_batch: int) -> int:
         from theanompi_tpu.utils.helper_funcs import divide_batches
 

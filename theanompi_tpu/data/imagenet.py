@@ -1,0 +1,315 @@
+"""ImageNet data object — sharded batch files + parallel loading.
+
+Parity counterpart of the reference's ImageNet pipeline
+(``theanompi/models/data/imagenet.py`` + its parallel hkl loader,
+SURVEY.md §2.9/§3.4 — mount empty, no file:line).  The reference
+pre-processed ImageNet into hickle (HDF5) batch files, sharded the
+file list per rank, broadcast the epoch's shuffled order from rank 0,
+and ran a separate loader process per worker that decoded the next
+file into a shared buffer while the GPU trained.
+
+TPU-native inversion of each piece:
+
+* **hkl batch files → ``.npz`` shard files** (``train_*.npz`` /
+  ``val_*.npz`` with uint8 ``x`` (N,H,W,3) and int ``y``).  Same
+  pre-decoded-batch design — decode cost is paid once at preparation
+  time, the training-time loader only reads + crops.
+* **rank-0 broadcast of the shuffle → seeded permutation.**  The epoch
+  order is a pure function of (seed, epoch), so every host computes
+  the identical order with zero communication.
+* **loader process + shared buffer → read-ahead thread feeding
+  ``DevicePrefetcher``.**  File t+1 is decoded while file t's batches
+  are consumed, and the prefetcher overlaps the sharded ``device_put``
+  with the device step — the same double buffering without the process
+  boundary (numpy releases the GIL for decode/copy).
+* **no data present → deterministic synthetic mode** (this environment
+  has no network egress): a small pool of class-conditional patterned
+  images is generated once and sampled per batch, so benches and tests
+  run the full pipeline (crop/flip/normalize/shard) with realistic
+  shapes and clearly-labelled synthetic content.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import queue
+import threading
+from typing import Callable, Iterator, Sequence
+
+import numpy as np
+
+from theanompi_tpu.data.base import Batch, Dataset
+from theanompi_tpu.data.utils import center_crop, normalize, random_crop_flip
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def readahead(items: Sequence, load: Callable, depth: int = 2) -> Iterator:
+    """Yield ``load(item)`` for each item, decoding ``depth`` ahead in a
+    background thread — the reference's parallel-loader overlap.
+
+    Abandoning the generator (GC / ``close()``) stops the producer:
+    its puts are timed and poll a stop event, so no thread or decoded
+    shard is leaked when a consumer takes fewer batches than the files
+    hold."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    sentinel = object()
+    stop = threading.Event()
+    err: list[BaseException] = []
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for it in items:
+                if stop.is_set() or not put(load(it)):
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            err.append(e)
+        finally:
+            put(sentinel)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            out = q.get()
+            if out is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield out
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# shard-size lookups are cached in-process and via an optional
+# manifest.json so a real-ImageNet directory (~1000+ shard files) is
+# not re-scanned per dataset instance (reference: per-rank loaders each
+# enumerated the batch-file list once at startup too)
+_SIZE_CACHE: dict[str, int] = {}
+
+
+def _file_size_map(data_dir: str, files: list[str]) -> dict[str, int]:
+    missing = [f for f in files if f not in _SIZE_CACHE]
+    if missing:
+        manifest = os.path.join(data_dir, "manifest.json")
+        if os.path.exists(manifest):
+            import json
+            with open(manifest) as fh:
+                m = json.load(fh)
+            for f in missing:
+                n = m.get(os.path.basename(f))
+                if n is not None:
+                    _SIZE_CACHE[f] = int(n)
+            missing = [f for f in missing if f not in _SIZE_CACHE]
+        for f in missing:
+            with np.load(f) as z:
+                _SIZE_CACHE[f] = len(z["y"])
+    return {f: _SIZE_CACHE[f] for f in files}
+
+
+def _synthetic_pool(n_images: int, n_classes: int, hw: int, seed: int):
+    """Pool of distinct patterned images (uint8) + labels.  Classes get
+    distinct low-frequency signatures so models can actually fit them."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:hw, 0:hw].astype(np.float32) / hw
+    labels = (np.arange(n_images) * max(n_classes // max(n_images, 1), 1)
+              ) % n_classes
+    imgs = np.empty((n_images, hw, hw, 3), np.uint8)
+    for i, c in enumerate(labels):
+        fx, fy = 1 + c % 5, 1 + (c // 5) % 5
+        phase = 2 * np.pi * (c % 97) / 97.0
+        base = np.sin(2 * np.pi * fx * xx + phase) * np.cos(2 * np.pi * fy * yy)
+        img = np.stack(
+            [base * (0.5 + 0.5 * np.sin(phase + k)) for k in range(3)], -1
+        )
+        img = img + 0.3 * rng.standard_normal((hw, hw, 3), dtype=np.float32)
+        imgs[i] = ((img - img.min()) / (img.max() - img.min() + 1e-8) * 255
+                   ).astype(np.uint8)
+    return imgs, labels.astype(np.int32)
+
+
+class ImageNet_data(Dataset):
+    """ImageNet batches from ``.npz`` shard files, or synthetic.
+
+    ``data_dir`` layout: ``train_*.npz`` and ``val_*.npz``, each with
+    ``x`` uint8 (N, store, store, 3) and ``y`` int labels.  Train
+    images are randomly cropped ``store → crop`` + mirrored; val images
+    are center-cropped.  File-list sharding over ``rank``/``size``
+    reproduces the reference's per-rank shard lists for async rules and
+    multi-host loading.
+    """
+
+    n_classes = 1000
+
+    def __init__(self, data_dir: str | None = None, crop: int = 224,
+                 seed: int = 0, synthetic_n: int = 8192,
+                 synthetic_pool: int = 256, synthetic_store: int = 256,
+                 readahead_depth: int = 2):
+        self.crop = crop
+        self.seed = seed
+        self.sample_shape = (crop, crop, 3)
+        self.readahead_depth = readahead_depth
+        self.synthetic = False
+        self.train_files: list[str] = []
+        self.val_files: list[str] = []
+
+        data_dir = data_dir or os.environ.get("THEANOMPI_TPU_IMAGENET")
+        if data_dir and os.path.isdir(data_dir):
+            self.train_files = sorted(glob.glob(os.path.join(data_dir, "train_*.npz")))
+            self.val_files = sorted(glob.glob(os.path.join(data_dir, "val_*.npz")))
+
+        if self.train_files:
+            self._file_sizes = _file_size_map(
+                data_dir, self.train_files + self.val_files)
+            self.n_train = sum(self._file_sizes[f] for f in self.train_files)
+            self.n_val = sum(self._file_sizes[f] for f in self.val_files)
+        else:
+            self.synthetic = True
+            self.n_train = synthetic_n
+            self.n_val = max(synthetic_n // 16, 256)
+            self._pool_x, self._pool_y = _synthetic_pool(
+                synthetic_pool, self.n_classes, synthetic_store, seed
+            )
+
+    # -- shared prep ---------------------------------------------------------
+
+    def _prep(self, x: np.ndarray) -> np.ndarray:
+        return normalize(x.astype(np.float32) / 255.0,
+                         IMAGENET_MEAN, IMAGENET_STD)
+
+    # -- synthetic path ------------------------------------------------------
+
+    def _synthetic_batches(self, n_batches: int, global_batch: int,
+                           rng: np.random.Generator, train: bool
+                           ) -> Iterator[Batch]:
+        pool = len(self._pool_x)
+        for _ in range(n_batches):
+            idx = rng.integers(0, pool, size=global_batch)
+            x, y = self._pool_x[idx], self._pool_y[idx]
+            if train:
+                x = random_crop_flip(x, self.crop, self.crop, rng)
+            else:
+                x = center_crop(x, self.crop, self.crop)
+            yield self._prep(x), y
+
+    # -- file path -----------------------------------------------------------
+
+    def _sharded_files(self, files: list[str], epoch: int | None,
+                       rank: int, size: int) -> list[str]:
+        files = list(files)
+        if epoch is not None:
+            order = np.random.default_rng(self.seed + 1000 + epoch)
+            files = [files[i] for i in order.permutation(len(files))]
+        if size > 1:
+            files = files[rank::size]
+        return files
+
+    def _file_batches(self, files: list[str], global_batch: int,
+                      aug_rng: np.random.Generator | None,
+                      shuffle_rng: np.random.Generator | None
+                      ) -> Iterator[Batch]:
+        """Stream batches across shard files with read-ahead decode.
+        Leftover tail samples of each file carry into the next batch."""
+
+        def load(path):
+            with np.load(path) as z:
+                return z["x"], z["y"].astype(np.int32)
+
+        buf_x: list[np.ndarray] = []
+        buf_y: list[np.ndarray] = []
+        buffered = 0
+        for x, y in readahead(files, load, self.readahead_depth):
+            if shuffle_rng is not None:
+                p = shuffle_rng.permutation(len(y))
+                x, y = x[p], y[p]
+            buf_x.append(x)
+            buf_y.append(y)
+            buffered += len(y)
+            while buffered >= global_batch:
+                x_all = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
+                y_all = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
+                xb, yb = x_all[:global_batch], y_all[:global_batch]
+                buf_x, buf_y = [x_all[global_batch:]], [y_all[global_batch:]]
+                buffered -= global_batch
+                if aug_rng is not None:
+                    xb = random_crop_flip(xb, self.crop, self.crop, aug_rng)
+                else:
+                    xb = center_crop(xb, self.crop, self.crop)
+                yield self._prep(xb), yb
+
+    # -- Dataset interface ---------------------------------------------------
+
+    def train_batches(self, epoch: int, global_batch: int,
+                      rank: int = 0, size: int = 1) -> Iterator[Batch]:
+        if self.synthetic:
+            rng = np.random.default_rng(
+                self.seed + 5000 + 7919 * epoch + 104729 * rank)
+            n = (self.n_train // size) // global_batch
+            yield from self._synthetic_batches(n, global_batch, rng, True)
+            return
+        files = self._sharded_files(self.train_files, epoch, rank, size)
+        aug = np.random.default_rng(self.seed + 5000 + 7919 * epoch + rank)
+        shuf = np.random.default_rng(self.seed + 9000 + 7919 * epoch + rank)
+        yield from self._file_batches(files, global_batch, aug, shuf)
+
+    def val_batches(self, global_batch: int,
+                    rank: int = 0, size: int = 1) -> Iterator[Batch]:
+        if self.synthetic:
+            rng = np.random.default_rng(self.seed + 31337 + rank)
+            n = (self.n_val // size) // global_batch
+            yield from self._synthetic_batches(n, global_batch, rng, False)
+            return
+        files = self._sharded_files(self.val_files, None, rank, size)
+        yield from self._file_batches(files, global_batch, None, None)
+
+    def n_train_batches(self, global_batch: int) -> int:
+        return self.n_train // global_batch
+
+    def n_train_batches_for(self, epoch: int, global_batch: int,
+                            rank: int = 0, size: int = 1) -> int:
+        if self.synthetic:
+            return (self.n_train // size) // global_batch
+        files = self._sharded_files(self.train_files, epoch, rank, size)
+        n_mine = sum(self._file_sizes[f] for f in files)
+        return n_mine // global_batch
+
+
+def prepare_imagenet_shards(src_images: np.ndarray, src_labels: np.ndarray,
+                            out_dir: str, prefix: str = "train",
+                            shard_size: int = 1024) -> list[str]:
+    """Offline prep: pack (N,H,W,3) uint8 images + labels into
+    ``{prefix}_NNNN.npz`` shard files — the rebuild's analogue of the
+    reference's hickle pre-processing scripts (SURVEY.md §2.9)."""
+    import json
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for i in range(0, len(src_labels), shard_size):
+        p = os.path.join(out_dir, f"{prefix}_{i // shard_size:04d}.npz")
+        np.savez(p, x=src_images[i:i + shard_size],
+                 y=src_labels[i:i + shard_size])
+        paths.append(p)
+    # maintain manifest.json so training-time init never scans shards
+    manifest_path = os.path.join(out_dir, "manifest.json")
+    manifest = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    for k, p in enumerate(paths):
+        manifest[os.path.basename(p)] = int(
+            min(shard_size, len(src_labels) - k * shard_size))
+    with open(manifest_path, "w") as fh:
+        json.dump(manifest, fh)
+    return paths

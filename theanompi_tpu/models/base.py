@@ -98,7 +98,7 @@ class TpuModel:
 
     def __init__(self, config: ModelConfig | None = None, mesh=None,
                  verbose: bool = True, shard_rank: int = 0,
-                 shard_size: int = 1):
+                 shard_size: int = 1, data: Dataset | None = None):
         self.config = config or self.default_config()
         self.verbose = verbose
         self.mesh = mesh if mesh is not None else data_mesh()
@@ -114,7 +114,9 @@ class TpuModel:
         self.current_epoch = 0
         self.current_info: dict = {}
 
-        self.data: Dataset = self.build_data()
+        # ``data`` lets N worker models in one process (async rules)
+        # share one Dataset instead of loading N copies
+        self.data: Dataset = data if data is not None else self.build_data()
         self.module: nn.Module = self.build_module()
 
         base_lr = self.config.learning_rate
@@ -253,7 +255,8 @@ class TpuModel:
                                             self.shard_rank, self.shard_size)
         self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh)
         self._train_iter = iter(self._train_prefetcher)
-        return self.data.n_train_batches(self.global_batch * self.shard_size)
+        return self.data.n_train_batches_for(epoch, self.global_batch,
+                                             self.shard_rank, self.shard_size)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -270,7 +273,10 @@ class TpuModel:
                                               self._next_rng())
         recorder.end("calc")  # async dispatch; device time lands on flush
         self._pending.append((count, metrics))
-        if len(self._pending) >= max(recorder.print_freq, 1):
+        # flush window: print_freq when printing, else a fixed window so
+        # quiet runs (print_freq<=0) still batch device syncs
+        window = recorder.print_freq if recorder.print_freq > 0 else 50
+        if len(self._pending) >= window:
             self._flush_metrics(recorder)
             recorder.print_train_info(count)
 

@@ -1,0 +1,121 @@
+"""ResNet-50 — the north-star recipe (bundled recipe #4: 8-worker BSP
+ImageNet; BASELINE.json configs[3], ≥2500 img/s on v5e-16).
+
+Parity counterpart of the reference's ``theanompi/models/resnet50.py``
+(SURVEY.md §2.8 — mount empty, no file:line): bottleneck ResNet-50
+with batch norm, SGD+momentum, step LR decay.  TPU-native choices:
+NHWC layout, bf16 compute on the MXU with fp32 master params
+(``compute_dtype='bfloat16'``), BN statistics pmean-ed across the data
+axis by the BSP step (parallel/bsp.py), and the whole fwd+bwd+psum+
+update fused into one jitted SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from theanompi_tpu.data.imagenet import ImageNet_data
+from theanompi_tpu.models import layers as L
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut on
+    stride/width change.  The final BN's scale is init to zero
+    (standard residual-friendly init; keeps early training stable at
+    large global batch)."""
+
+    features: int            # bottleneck width; output is 4x this
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = lambda scale_init=nn.initializers.ones: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=self.dtype, scale_init=scale_init)
+        out_features = self.features * 4
+
+        residual = x
+        if residual.shape[-1] != out_features or self.strides != (1, 1):
+            residual = L.Conv(out_features, (1, 1), strides=self.strides,
+                              use_bias=False, dtype=self.dtype,
+                              name="proj_conv")(residual)
+            residual = norm()(residual)
+
+        y = L.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = L.Conv(self.features, (3, 3), strides=self.strides,
+                   use_bias=False, dtype=self.dtype)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = L.Conv(out_features, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Generic bottleneck ResNet (50 = (3,4,6,3))."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    width: int = 64
+    n_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = L.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                   use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(self.width * (2 ** stage), strides,
+                                    self.dtype)(x, train)
+        x = L.global_avg_pool(x)
+        x = L.Dense(self.n_classes, kernel_init=L.xavier_init())(x)
+        return x.astype(jnp.float32)
+
+
+class ResNet50(TpuModel):
+    name = "resnet50"
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        # The reference-era 90-epoch step recipe (SURVEY.md §5.6), with
+        # linear LR scaling over workers for the 8-worker BSP config.
+        return ModelConfig(
+            batch_size=128,
+            n_epochs=90,
+            learning_rate=0.05,     # per 128-batch; scaled by n_workers
+            momentum=0.9,
+            weight_decay=1e-4,
+            lr_schedule="step",
+            lr_decay_epochs=(30, 60, 80),
+            lr_decay_factor=0.1,
+            lr_scale_with_workers="linear",
+            compute_dtype="bfloat16",
+            track_top5=True,
+            print_freq=20,
+        )
+
+    def build_module(self) -> nn.Module:
+        dtype = (jnp.bfloat16 if self.config.compute_dtype == "bfloat16"
+                 else jnp.float32)
+        return ResNet(n_classes=self.data.n_classes, dtype=dtype)
+
+    def build_data(self):
+        return ImageNet_data(data_dir=self.config.data_dir,
+                             seed=self.config.seed)
+
+
+# reference-style alias (upstream files exposed Model-suffixed names too)
+ResNet50_model = ResNet50

@@ -53,6 +53,9 @@ class _AsyncRule(Rule):
             m = cls(config=config, mesh=data_mesh(1, [dev]),
                     shard_rank=i, shard_size=len(devs), **kwargs)
             models.append(m)
+            # share worker 0's dataset: iterators are created per epoch
+            # and the source arrays/files are read-only
+            kwargs.setdefault("data", m.data)
         return models
 
     def _run_worker_threads(self, targets):
@@ -153,7 +156,8 @@ class EASGD(_AsyncRule):
         # Owns its own model instance: worker 0's state is being mutated
         # concurrently by its thread.
         val_model = resolve_model_class(modelfile, modelclass)(
-            config=config, mesh=data_mesh(1, [devs[0]]), **kwargs)
+            config=config, mesh=data_mesh(1, [devs[0]]),
+            **{**kwargs, "data": models[0].data})
         val_model.compile_iter_fns("avg")
         # rank 0 so the per-epoch summary prints; worker recorders are
         # never touched from this thread

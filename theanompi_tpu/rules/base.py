@@ -50,8 +50,12 @@ def resolve_model_class(modelfile: str, modelclass: str) -> type:
 
 
 def resolve_devices(devices: int | Sequence | None) -> list:
-    """Accept None (all), an int count, device indices, or jax Devices."""
-    all_devs = jax.devices()
+    """Accept None (all), an int count, device indices, or jax Devices.
+
+    Uses *local* devices: a rule session runs in one process and must
+    only place state on devices this process addresses (under
+    multi-host launch each host process drives its own chips)."""
+    all_devs = jax.local_devices()
     if devices is None:
         return list(all_devs)
     if isinstance(devices, int):
