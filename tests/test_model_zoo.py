@@ -1,0 +1,147 @@
+"""Model zoo: AlexNet / VGG16 / GoogLeNet on the 8-device CPU mesh
+(tiny crops so CI-speed; full geometry is exercised by bench/real-chip
+runs).  Reference zoo per SURVEY.md §2.8."""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.data.imagenet import ImageNet_data
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def tiny_imagenet(crop, **kw):
+    kw.setdefault("synthetic_n", 256)
+    kw.setdefault("synthetic_pool", 8)
+    kw.setdefault("synthetic_store", max(crop + 12, 20))
+    return ImageNet_data(crop=crop, **kw)
+
+
+def run_short_training(model, n_iters=3):
+    model.compile_iter_fns("avg")
+    rec = Recorder(rank=1, size=8, print_freq=100)
+    model.begin_epoch(0)
+    for i in range(n_iters):
+        model.train_iter(i, rec)
+    model._flush_metrics(rec)
+    assert np.isfinite(model.current_info["loss"])
+    val = model.val_epoch(rec)
+    assert 0.0 <= val["error"] <= 1.0
+    model.cleanup()
+    return val
+
+
+class TestAlexNet:
+    def make(self, mesh8):
+        from theanompi_tpu.models.alex_net import AlexNet
+
+        class TinyAlex(AlexNet):
+            def build_data(self):
+                # 67 → conv11/4 valid 15 → pool 7 → pool 3 → pool 1
+                return tiny_imagenet(67)
+
+        cfg = ModelConfig(batch_size=2, n_epochs=1, compute_dtype="float32",
+                          print_freq=100)
+        return TinyAlex(config=cfg, mesh=mesh8)
+
+    def test_grouped_conv_param_shapes(self, mesh8):
+        import jax
+        m = self.make(mesh8)
+        shapes = [np.shape(v) for v in jax.tree.leaves(m.state.params)]
+        # conv2 has 2 groups: kernel in-channels = 96/2 = 48
+        assert any(s == (5, 5, 48, 256) for s in shapes), shapes
+
+    def test_train_and_val(self, mesh8):
+        run_short_training(self.make(mesh8))
+
+
+class TestVGG16:
+    def make(self, mesh8):
+        import jax.numpy as jnp
+        from theanompi_tpu.models.vgg16 import VGG16, VGGCNN
+
+        class TinyVGG(VGG16):
+            def build_data(self):
+                return tiny_imagenet(32)
+
+            def build_module(self):
+                return VGGCNN(blocks=((1, 8), (1, 16), (2, 16)),
+                              n_classes=self.data.n_classes,
+                              dtype=jnp.float32)
+
+        cfg = ModelConfig(batch_size=2, n_epochs=1, compute_dtype="float32",
+                          print_freq=100)
+        return TinyVGG(config=cfg, mesh=mesh8)
+
+    def test_train_and_val(self, mesh8):
+        run_short_training(self.make(mesh8))
+
+    def test_full_blocks_shape(self):
+        from theanompi_tpu.models.vgg16 import VGG16_BLOCKS
+        assert sum(n for n, _ in VGG16_BLOCKS) == 13  # conf. D: 13 convs
+
+
+class TestGoogLeNet:
+    def make(self, mesh8):
+        from theanompi_tpu.models.googlenet import GoogLeNet
+
+        class TinyGoogLeNet(GoogLeNet):
+            def build_data(self):
+                # 64 → stem/2 32 → pool 16 → pool 8 (4a at 8x8: aux
+                # 5x5/3 avg-pool valid → 2x2, still well-formed)
+                return tiny_imagenet(64)
+
+        cfg = ModelConfig(batch_size=2, n_epochs=1, compute_dtype="float32",
+                          print_freq=100)
+        return TinyGoogLeNet(config=cfg, mesh=mesh8)
+
+    def test_aux_heads_exist_and_train(self, mesh8):
+        m = self.make(mesh8)
+        assert "aux1" in m.state.params and "aux2" in m.state.params
+        run_short_training(m)
+
+    def test_eval_path_skips_aux(self, mesh8):
+        import jax.numpy as jnp
+        m = self.make(mesh8)
+        x = jnp.zeros((2, 64, 64, 3))
+        variables = {"params": m.state.params, **m.state.model_state}
+        out = m.module.apply(variables, x, train=False)
+        assert out.shape == (2, m.data.n_classes)  # plain logits at eval
+
+
+def test_zoo_registry_resolves():
+    from theanompi_tpu.models import MODEL_ZOO
+    from theanompi_tpu.rules import resolve_model_class
+
+    for shortname, (mod, cls) in MODEL_ZOO.items():
+        klass = resolve_model_class(mod, cls)
+        assert isinstance(klass, type), shortname
+
+
+class TestZooVariants:
+    def test_vgg19_blocks(self):
+        from theanompi_tpu.models.model_zoo import VGG19_BLOCKS
+        assert sum(n for n, _ in VGG19_BLOCKS) == 16  # conf. E: 16 convs
+
+    def test_resnet_variant_depths(self, mesh8):
+        import jax
+        from theanompi_tpu.models.model_zoo import ResNet101, ResNet152
+        from theanompi_tpu.models.resnet50 import ResNet
+
+        # depth = 3*sum(stages)+2 (bottleneck) — 101 and 152
+        assert 3 * sum(ResNet101.stage_sizes) + 2 == 101
+        assert 3 * sum(ResNet152.stage_sizes) + 2 == 152
+
+        class TinyR101(ResNet101):
+            def build_data(self):
+                return tiny_imagenet(16)
+
+            def build_module(self):
+                import jax.numpy as jnp
+                return ResNet(stage_sizes=(1, 1, 1, 1), width=8,
+                              n_classes=self.data.n_classes,
+                              dtype=jnp.float32)
+
+        cfg = ModelConfig(batch_size=2, n_epochs=1, compute_dtype="float32",
+                          print_freq=100)
+        run_short_training(TinyR101(config=cfg, mesh=mesh8), n_iters=2)

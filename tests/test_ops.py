@@ -39,3 +39,53 @@ def test_lrn_differentiable():
     x = jnp.ones((1, 2, 2, 4))
     g = jax.grad(lambda y: lrn(y).sum())(x)
     assert np.isfinite(np.asarray(g)).all()
+
+
+class TestLRNPallas:
+    """lrn_pallas runs in interpret mode off-TPU (conftest pins cpu),
+    so numerics and the analytic VJP are testable on the CPU mesh."""
+
+    def test_matches_xla_impl(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 5, 96).astype(np.float32)
+        got = np.asarray(lrn(jnp.asarray(x), impl="pallas"))
+        want = np.asarray(lrn(jnp.asarray(x), impl="xla"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_analytic_vjp_matches_autodiff(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(2, 2, 3, 16).astype(np.float32))
+        ct = jnp.asarray(rng.randn(2, 2, 3, 16).astype(np.float32))
+        g_pallas = jax.grad(lambda v: (lrn(v, impl="pallas") * ct).sum())(x)
+        g_xla = jax.grad(lambda v: (lrn(v, impl="xla") * ct).sum())(x)
+        np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_xla),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_non_tile_aligned_rows(self, monkeypatch):
+        # force a genuinely ragged grid: TILE_M=8 with m=N*H*W=10 →
+        # 2 blocks, last one masked; results must still be exact
+        from theanompi_tpu.ops import lrn_pallas as lp
+        monkeypatch.setattr(lp, "TILE_M", 8)
+        rng = np.random.RandomState(4)
+        x = rng.randn(1, 2, 5, 8).astype(np.float32)
+        got = np.asarray(lrn(jnp.asarray(x), impl="pallas"))
+        want = np.asarray(lrn(jnp.asarray(x), impl="xla"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_even_window_gradient(self):
+        # even n: the window is asymmetric, so the VJP must use the
+        # adjoint window — compare against autodiff of the XLA form
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(1, 2, 3, 12).astype(np.float32))
+        ct = jnp.asarray(rng.randn(1, 2, 3, 12).astype(np.float32))
+        g_pallas = jax.grad(
+            lambda v: (lrn(v, n=4, impl="pallas") * ct).sum())(x)
+        g_xla = jax.grad(
+            lambda v: (lrn(v, n=4, impl="xla") * ct).sum())(x)
+        np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_xla),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bad_impl_rejected(self):
+        import pytest
+        with pytest.raises(ValueError):
+            lrn(jnp.ones((1, 1, 1, 4)), impl="cuda")

@@ -12,6 +12,10 @@ MODEL_ZOO = {
     "vgg16": ("theanompi_tpu.models.vgg16", "VGG16"),
     "resnet50": ("theanompi_tpu.models.resnet50", "ResNet50"),
     "wgan": ("theanompi_tpu.models.wasserstein_gan", "Wasserstein_GAN"),
+    # zoo variants (reference lasagne_model_zoo equivalents)
+    "vgg19": ("theanompi_tpu.models.model_zoo", "VGG19"),
+    "resnet101": ("theanompi_tpu.models.model_zoo", "ResNet101"),
+    "resnet152": ("theanompi_tpu.models.model_zoo", "ResNet152"),
 }
 
 __all__ = ["MODEL_ZOO"]

@@ -1,0 +1,114 @@
+"""AlexNet — the paper's main benchmark model (bundled recipe #2:
+AlexNet-128 ImageNet, 2-worker BSP allreduce; BASELINE.json
+configs[1]).
+
+Parity counterpart of the reference's ``theanompi/models/alex_net.py``
+(SURVEY.md §2.8 — mount empty, no file:line): the one-column AlexNet
+variant the reference trained at batch 128 — grouped conv2/4/5 (the
+original's dual-GPU split kept as channel grouping), cross-channel
+LRN after conv1/conv2, overlapping 3x2 max pools, two dropout FC
+layers, softmax over 1000 classes, SGD+momentum with step LR decay.
+
+TPU-native choices: the reference routed grouped convolution to
+cuDNN's ``groups``; here it is XLA's ``feature_group_count``, which
+tiles onto the MXU like any other conv.  LRN dispatches through
+theanompi_tpu.ops.lrn (Pallas kernel on TPU, composed XLA elsewhere).
+Compute dtype is configurable; bf16 puts the conv/matmul FLOPs on the
+MXU at full rate.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from theanompi_tpu.data.imagenet import ImageNet_data
+from theanompi_tpu.models import layers as L
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+
+
+class AlexNetCNN(nn.Module):
+    """One-column AlexNet with channel grouping (NHWC)."""
+
+    n_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        # conv1: 96 @ 11x11 /4  → LRN → pool
+        x = L.Conv(96, (11, 11), strides=(4, 4), padding="VALID",
+                   kernel_init=L.gaussian_init(0.01),
+                   bias_init=L.constant_init(0.0), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
+        x = L.max_pool(x, 3, 2)
+        # conv2: 256 @ 5x5, 2 groups → LRN → pool
+        x = L.Conv(256, (5, 5), groups=2,
+                   kernel_init=L.gaussian_init(0.01),
+                   bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
+        x = L.max_pool(x, 3, 2)
+        # conv3/4/5
+        x = L.Conv(384, (3, 3),
+                   kernel_init=L.gaussian_init(0.01),
+                   bias_init=L.constant_init(0.0), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.Conv(384, (3, 3), groups=2,
+                   kernel_init=L.gaussian_init(0.01),
+                   bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.Conv(256, (3, 3), groups=2,
+                   kernel_init=L.gaussian_init(0.01),
+                   bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.max_pool(x, 3, 2)
+        # fc6/fc7 with dropout, fc8 softmax head
+        x = x.reshape((x.shape[0], -1))
+        x = L.Dense(4096, kernel_init=L.gaussian_init(0.005),
+                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.Dropout(0.5)(x, train)
+        x = L.Dense(4096, kernel_init=L.gaussian_init(0.005),
+                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.Dropout(0.5)(x, train)
+        x = L.Dense(self.n_classes, kernel_init=L.gaussian_init(0.01),
+                    dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class AlexNet(TpuModel):
+    name = "alexnet"
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        # The reference's batch-128 recipe (SURVEY.md §2.8/§5.6): SGD
+        # momentum 0.9, wd 5e-4, LR 0.01 stepped down through training.
+        return ModelConfig(
+            batch_size=128,
+            n_epochs=70,
+            learning_rate=0.01,
+            momentum=0.9,
+            weight_decay=5e-4,
+            lr_schedule="step",
+            lr_decay_epochs=(20, 40, 60),
+            lr_decay_factor=0.1,
+            compute_dtype="bfloat16",
+            track_top5=True,
+            print_freq=40,
+        )
+
+    def build_module(self) -> nn.Module:
+        dtype = self._compute_dtype()
+        return AlexNetCNN(n_classes=self.data.n_classes, dtype=dtype)
+
+    def build_data(self):
+        # AlexNet trains on 227x227 crops (valid-padded 11x11/4 stem).
+        return ImageNet_data(data_dir=self.config.data_dir, crop=227,
+                             seed=self.config.seed)
+
+
+# reference-style alias
+AlexNet_model = AlexNet

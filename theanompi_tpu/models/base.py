@@ -127,8 +127,11 @@ class TpuModel:
 
         rng = jax.random.key(self.config.seed)
         dummy = jnp.zeros((2, *self.data.sample_shape), self._input_dtype())
+        # init traces the TRAINING path so train-only parameters (e.g.
+        # GoogLeNet's aux heads) are created; flax skips running-stat
+        # writes while initializing, so BN state stays at its init values
         variables = self.module.init({"params": rng, "dropout": rng}, dummy,
-                                     train=False)
+                                     train=True)
         variables = dict(variables)
         params = variables.pop("params")
         model_state = variables  # e.g. {'batch_stats': ...} or {}
@@ -158,6 +161,11 @@ class TpuModel:
 
     def _input_dtype(self):
         return jnp.float32
+
+    def _compute_dtype(self):
+        """MXU compute dtype from config (params stay fp32 masters)."""
+        return (jnp.bfloat16 if self.config.compute_dtype == "bfloat16"
+                else jnp.float32)
 
     # -- optimizer / loss ----------------------------------------------------
 

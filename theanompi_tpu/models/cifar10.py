@@ -60,8 +60,7 @@ class Cifar10_model(TpuModel):
         )
 
     def build_module(self) -> nn.Module:
-        dtype = jnp.bfloat16 if self.config.compute_dtype == "bfloat16" else jnp.float32
-        return Cifar10CNN(dtype=dtype)
+        return Cifar10CNN(dtype=self._compute_dtype())
 
     def build_data(self):
         return Cifar10_data(data_dir=self.config.data_dir,

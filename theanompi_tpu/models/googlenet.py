@@ -1,0 +1,161 @@
+"""GoogLeNet (Inception v1) — bundled recipe #3 (VGG16/GoogLeNet
+ImageNet BSP; BASELINE.json configs[2]).
+
+Parity counterpart of the reference's ``theanompi/models/googlenet.py``
+(SURVEY.md §2.8 — mount empty, no file:line): the 22-layer inception
+network — 9 inception modules with 1x1/3x3/5x5 branches and pool
+projection, LRN around the stem, two auxiliary softmax heads (weight
+0.3) on inception 4a/4d during training, global average pooling and a
+single FC head, SGD+momentum with polynomial LR decay (the GoogLeNet
+paper's schedule, which the reference followed).
+
+The aux-head training loss is the weighted sum handled generically by
+``TpuModel.loss_fn`` — during training the module returns
+``(main_logits, (aux1, 0.3), (aux2, 0.3))``; at eval it returns the
+main logits only, so the aux towers fold away in the eval program.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from theanompi_tpu.data.imagenet import ImageNet_data
+from theanompi_tpu.models import layers as L
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+
+
+class ConvRelu(nn.Module):
+    features: int
+    kernel: tuple[int, int] = (1, 1)
+    strides: tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = L.Conv(self.features, self.kernel, strides=self.strides,
+                   padding=self.padding, kernel_init=L.xavier_init(),
+                   bias_init=L.constant_init(0.2), dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class Inception(nn.Module):
+    """One inception module: 1x1 | 1x1→3x3 | 1x1→5x5 | pool→1x1,
+    concatenated on channels."""
+
+    b1: int          # 1x1 branch width
+    b3r: int         # 3x3 reduce
+    b3: int          # 3x3 branch width
+    b5r: int         # 5x5 reduce
+    b5: int          # 5x5 branch width
+    bp: int          # pool-projection width
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        p1 = ConvRelu(self.b1, (1, 1), dtype=self.dtype)(x)
+        p3 = ConvRelu(self.b3r, (1, 1), dtype=self.dtype)(x)
+        p3 = ConvRelu(self.b3, (3, 3), dtype=self.dtype)(p3)
+        p5 = ConvRelu(self.b5r, (1, 1), dtype=self.dtype)(x)
+        p5 = ConvRelu(self.b5, (5, 5), dtype=self.dtype)(p5)
+        pp = nn.max_pool(x, (3, 3), (1, 1), padding="SAME")
+        pp = ConvRelu(self.bp, (1, 1), dtype=self.dtype)(pp)
+        return jnp.concatenate([p1, p3, p5, pp], axis=-1)
+
+
+class AuxHead(nn.Module):
+    """Auxiliary classifier: 5x5/3 avg pool → 1x1 conv → FC → softmax
+    head (the regularizing side towers of the original network)."""
+
+    n_classes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.avg_pool(x, (5, 5), (3, 3), padding="VALID")
+        x = ConvRelu(128, (1, 1), dtype=self.dtype)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = L.Dense(1024, kernel_init=L.gaussian_init(0.01),
+                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.Dropout(0.7)(x, train)
+        x = L.Dense(self.n_classes, kernel_init=L.gaussian_init(0.01),
+                    dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class GoogLeNetCNN(nn.Module):
+    n_classes: int = 1000
+    aux_weight: float = 0.3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        # stem
+        x = ConvRelu(64, (7, 7), strides=(2, 2), dtype=self.dtype)(x)
+        x = L.max_pool(x, 3, 2, padding="SAME")
+        x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
+        x = ConvRelu(64, (1, 1), dtype=self.dtype)(x)
+        x = ConvRelu(192, (3, 3), dtype=self.dtype)(x)
+        x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
+        x = L.max_pool(x, 3, 2, padding="SAME")
+        # inception 3a/3b
+        x = Inception(64, 96, 128, 16, 32, 32, self.dtype)(x)
+        x = Inception(128, 128, 192, 32, 96, 64, self.dtype)(x)
+        x = L.max_pool(x, 3, 2, padding="SAME")
+        # inception 4a..4e with aux heads off 4a and 4d
+        x = Inception(192, 96, 208, 16, 48, 64, self.dtype)(x)
+        aux1 = (AuxHead(self.n_classes, self.dtype, name="aux1")(x, train)
+                if train else None)
+        x = Inception(160, 112, 224, 24, 64, 64, self.dtype)(x)
+        x = Inception(128, 128, 256, 24, 64, 64, self.dtype)(x)
+        x = Inception(112, 144, 288, 32, 64, 64, self.dtype)(x)
+        aux2 = (AuxHead(self.n_classes, self.dtype, name="aux2")(x, train)
+                if train else None)
+        x = Inception(256, 160, 320, 32, 128, 128, self.dtype)(x)
+        x = L.max_pool(x, 3, 2, padding="SAME")
+        # inception 5a/5b
+        x = Inception(256, 160, 320, 32, 128, 128, self.dtype)(x)
+        x = Inception(384, 192, 384, 48, 128, 128, self.dtype)(x)
+        # head
+        x = L.global_avg_pool(x)
+        x = L.Dropout(0.4)(x, train)
+        x = L.Dense(self.n_classes, kernel_init=L.xavier_init(),
+                    dtype=self.dtype)(x)
+        main = x.astype(jnp.float32)
+        if train:
+            return (main, (aux1, self.aux_weight), (aux2, self.aux_weight))
+        return main
+
+
+class GoogLeNet(TpuModel):
+    name = "googlenet"
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return ModelConfig(
+            batch_size=64,
+            n_epochs=70,
+            learning_rate=0.01,
+            momentum=0.9,
+            weight_decay=2e-4,
+            lr_schedule="poly",
+            lr_poly_power=0.5,
+            compute_dtype="bfloat16",
+            track_top5=True,
+            print_freq=40,
+        )
+
+    def build_module(self) -> nn.Module:
+        dtype = self._compute_dtype()
+        return GoogLeNetCNN(n_classes=self.data.n_classes, dtype=dtype)
+
+    def build_data(self):
+        return ImageNet_data(data_dir=self.config.data_dir, crop=224,
+                             seed=self.config.seed)
+
+
+# reference-style alias
+GoogLeNet_model = GoogLeNet

@@ -1,0 +1,34 @@
+"""Extra model-zoo variants — parity counterpart of the reference's
+``theanompi/models/lasagne_model_zoo/`` (SURVEY.md §2.8 — mount empty,
+no file:line), which carried Lasagne-based VGG and ResNet variants
+alongside the first-class models.
+
+Here the variants are thin reconfigurations of the first-class flax
+networks (the TPU-native analogue of "another model-zoo frontend over
+the same layers"): VGG19 (configuration E) and deeper bottleneck
+ResNets (101/152).  Each keeps the full model contract, so every rule
+and launcher drives them like any zoo member.
+"""
+
+from __future__ import annotations
+
+from theanompi_tpu.models.resnet50 import ResNet50
+from theanompi_tpu.models.vgg16 import VGG16
+
+# configuration E: (n_convs, features) per block — 16 convs + 3 FC
+VGG19_BLOCKS = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
+
+
+class VGG19(VGG16):
+    name = "vgg19"
+    blocks = VGG19_BLOCKS
+
+
+class ResNet101(ResNet50):
+    name = "resnet101"
+    stage_sizes = (3, 4, 23, 3)
+
+
+class ResNet152(ResNet101):
+    name = "resnet152"
+    stage_sizes = (3, 8, 36, 3)

@@ -87,6 +87,7 @@ class ResNet(nn.Module):
 
 class ResNet50(TpuModel):
     name = "resnet50"
+    stage_sizes = (3, 4, 6, 3)   # zoo variants (101/152) override this
 
     @classmethod
     def default_config(cls) -> ModelConfig:
@@ -108,9 +109,9 @@ class ResNet50(TpuModel):
         )
 
     def build_module(self) -> nn.Module:
-        dtype = (jnp.bfloat16 if self.config.compute_dtype == "bfloat16"
-                 else jnp.float32)
-        return ResNet(n_classes=self.data.n_classes, dtype=dtype)
+        return ResNet(stage_sizes=self.stage_sizes,
+                      n_classes=self.data.n_classes,
+                      dtype=self._compute_dtype())
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir,

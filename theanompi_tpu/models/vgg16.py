@@ -1,0 +1,89 @@
+"""VGG16 — bundled recipe #3 (VGG16/GoogLeNet ImageNet BSP;
+BASELINE.json configs[2]).
+
+Parity counterpart of the reference's ``theanompi/models/vgg16.py``
+and its Lasagne-zoo variant (SURVEY.md §2.8 — mount empty, no
+file:line): the 13-conv/3-FC configuration-D network — 3x3 convs in
+blocks of 2,2,3,3,3 with 2x2 max pools, two 4096-wide dropout FC
+layers, softmax over 1000 classes, SGD+momentum.
+
+TPU notes: VGG is almost pure conv FLOPs — ideal MXU food in bf16.
+The 25088→4096 fc6 matmul dominates the parameter count; it stays a
+single dense op (XLA tiles it).  The reference trained VGG at a small
+per-GPU batch for memory; v5e HBM fits 64 at bf16 comfortably.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from theanompi_tpu.data.imagenet import ImageNet_data
+from theanompi_tpu.models import layers as L
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+
+# configuration D: (n_convs, features) per block
+VGG16_BLOCKS = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+
+class VGGCNN(nn.Module):
+    blocks: tuple = VGG16_BLOCKS
+    n_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for n_convs, features in self.blocks:
+            for _ in range(n_convs):
+                x = L.Conv(features, (3, 3),
+                           kernel_init=L.he_init(),
+                           bias_init=L.constant_init(0.0),
+                           dtype=self.dtype)(x)
+                x = nn.relu(x)
+            x = L.max_pool(x, 2, 2)
+        x = x.reshape((x.shape[0], -1))
+        x = L.Dense(4096, kernel_init=L.gaussian_init(0.005),
+                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.Dropout(0.5)(x, train)
+        x = L.Dense(4096, kernel_init=L.gaussian_init(0.005),
+                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = L.Dropout(0.5)(x, train)
+        x = L.Dense(self.n_classes, kernel_init=L.gaussian_init(0.01),
+                    dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class VGG16(TpuModel):
+    name = "vgg16"
+    blocks = VGG16_BLOCKS   # zoo variants (VGG19) override this
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return ModelConfig(
+            batch_size=64,
+            n_epochs=70,
+            learning_rate=0.01,
+            momentum=0.9,
+            weight_decay=5e-4,
+            lr_schedule="step",
+            lr_decay_epochs=(25, 50, 65),
+            lr_decay_factor=0.1,
+            compute_dtype="bfloat16",
+            track_top5=True,
+            print_freq=40,
+        )
+
+    def build_module(self) -> nn.Module:
+        return VGGCNN(blocks=self.blocks, n_classes=self.data.n_classes,
+                      dtype=self._compute_dtype())
+
+    def build_data(self):
+        return ImageNet_data(data_dir=self.config.data_dir, crop=224,
+                             seed=self.config.seed)
+
+
+# reference-style alias
+VGG16_model = VGG16

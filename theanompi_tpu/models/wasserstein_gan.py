@@ -1,0 +1,310 @@
+"""Wasserstein GAN — the reference zoo's two-network training loop
+(``theanompi/models/wasserstein_gan.py``, SURVEY.md §2.8 — mount
+empty, no file:line): DCGAN-shaped generator + critic trained with
+the WGAN recipe (Arjovsky et al. 2017) — RMSprop, ``n_critic`` critic
+updates per generator update, critic weights clipped to ``[-c, c]``.
+
+TPU-native design: the reference alternated separately-compiled
+Theano functions from Python; here the WHOLE round — ``n_critic``
+critic updates (``lax.scan``) followed by one generator update, with
+every gradient psum-ed over the data axis — is ONE jitted SPMD
+program, so the inner loop never bounces to the host and XLA overlaps
+the ICI collectives with backprop.
+
+The model keeps the standard contract (``compile_iter_fns`` /
+``train_iter`` / ``val_epoch`` / ``save`` / ``load``), so
+``run_bsp_session`` and the launchers drive it unchanged; its state is
+a two-optimizer ``WGANState`` instead of the classifier
+``TrainState``.  Metric names: ``loss`` is the negated critic loss —
+the Wasserstein-distance estimate (lower = distributions closer);
+``error`` carries the generator loss so the recorder's two columns
+stay meaningful.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.data.cifar10 import Cifar10_data
+from theanompi_tpu.models import layers as L
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+from theanompi_tpu.parallel.mesh import AXIS_DATA, replicate
+from theanompi_tpu.utils.helper_funcs import (
+    load_params_npz,
+    save_params_npz,
+    scale_lr,
+)
+from theanompi_tpu.utils.recorder import Recorder
+
+PyTree = Any
+
+
+class Generator(nn.Module):
+    """z → 32x32x3 image in [-1, 1] (DCGAN-shaped upsampling stack)."""
+
+    width: int = 128
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z):
+        z = z.astype(self.dtype)
+        x = L.Dense(4 * 4 * self.width * 2, kernel_init=L.gaussian_init(0.02),
+                    dtype=self.dtype)(z)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], 4, 4, self.width * 2))
+        for w in (self.width * 2, self.width):          # 4→8→16
+            x = nn.ConvTranspose(w, (4, 4), strides=(2, 2), padding="SAME",
+                                 kernel_init=L.gaussian_init(0.02),
+                                 dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.ConvTranspose(3, (4, 4), strides=(2, 2), padding="SAME",
+                             kernel_init=L.gaussian_init(0.02),
+                             dtype=self.dtype)(x)      # 16→32
+        return jnp.tanh(x).astype(jnp.float32)
+
+
+class Critic(nn.Module):
+    """32x32x3 image → scalar score (no sigmoid — Wasserstein critic)."""
+
+    width: int = 128
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for w in (self.width // 2, self.width, self.width * 2):  # 32→16→8→4
+            x = L.Conv(w, (4, 4), strides=(2, 2),
+                       kernel_init=L.gaussian_init(0.02),
+                       dtype=self.dtype)(x)
+            x = nn.leaky_relu(x, 0.2)
+        x = x.reshape((x.shape[0], -1))
+        x = L.Dense(1, kernel_init=L.gaussian_init(0.02),
+                    dtype=self.dtype)(x)
+        return x.astype(jnp.float32)[:, 0]
+
+
+@struct.dataclass
+class WGANState:
+    step: jax.Array
+    gen_params: PyTree
+    gen_opt: PyTree
+    critic_params: PyTree
+    critic_opt: PyTree
+
+
+class WGANCifar_data(Cifar10_data):
+    """CIFAR images scaled to the generator's tanh range [-1, 1]
+    (instead of the classifier mean/std normalization)."""
+
+    def _prep(self, x: np.ndarray) -> np.ndarray:
+        return x.astype(np.float32) / 127.5 - 1.0
+
+
+def clip_params(params: PyTree, c: float) -> PyTree:
+    """The WGAN weight clip — Lipschitz constraint on the critic."""
+    return jax.tree.map(lambda p: jnp.clip(p, -c, c), params)
+
+
+class Wasserstein_GAN(TpuModel):
+    """WGAN over CIFAR-shaped images; BSP data-parallel."""
+
+    name = "wgan"
+    latent_dim = 100
+    n_critic = 5
+    clip_c = 0.01
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return ModelConfig(
+            batch_size=64,
+            n_epochs=50,
+            learning_rate=5e-5,     # RMSprop, constant (WGAN recipe)
+            momentum=0.0,
+            weight_decay=0.0,
+            lr_schedule="constant",
+            print_freq=20,
+        )
+
+    def __init__(self, config: ModelConfig | None = None, mesh=None,
+                 verbose: bool = True, shard_rank: int = 0,
+                 shard_size: int = 1, data=None, width: int = 64):
+        # two-network state: rebuild the base scaffolding around a
+        # (generator, critic) pair instead of calling TpuModel.__init__
+        from theanompi_tpu.parallel.mesh import data_axis_size, data_mesh
+
+        self.config = config or self.default_config()
+        self.verbose = verbose
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self.n_workers = data_axis_size(self.mesh)
+        self.shard_rank = shard_rank
+        self.shard_size = shard_size
+        self.batch_size = self.config.batch_size
+        # one fused round consumes a FRESH real minibatch per critic
+        # update (the WGAN recipe) plus none for the generator, so the
+        # data pipeline feeds n_critic * batch_size images per step
+        self.global_batch = self.batch_size * self.n_workers * self.n_critic
+        self.n_epochs = self.config.n_epochs
+        self.current_epoch = 0
+        self.current_info: dict = {}
+
+        self.data = data if data is not None else self.build_data()
+        dtype = self._compute_dtype()
+        self.generator = Generator(width=width * 2, dtype=dtype)
+        self.critic = Critic(width=width * 2, dtype=dtype)
+        self.module = self.generator  # for introspection/tabulate
+
+        rng = jax.random.key(self.config.seed)
+        g_rng, c_rng = jax.random.split(rng)
+        z = jnp.zeros((2, self.latent_dim), jnp.float32)
+        x = jnp.zeros((2, *self.data.sample_shape), jnp.float32)
+        gen_params = self.generator.init(g_rng, z)["params"]
+        critic_params = self.critic.init(c_rng, x)["params"]
+
+        base_lr = self.config.learning_rate
+        if self.config.lr_scale_with_workers:
+            base_lr = scale_lr(base_lr, self.n_workers,
+                               self.config.lr_scale_with_workers)
+        self._base_lr = base_lr
+        self.gen_tx = optax.rmsprop(self._base_lr)
+        self.critic_tx = optax.rmsprop(self._base_lr)
+
+        state = WGANState(
+            step=jnp.zeros((), jnp.int32),
+            gen_params=gen_params,
+            gen_opt=self.gen_tx.init(gen_params),
+            critic_params=clip_params(critic_params, self.clip_c),
+            critic_opt=self.critic_tx.init(critic_params),
+        )
+        self.state = replicate(state, self.mesh)
+
+        self._rng = jax.random.key(self.config.seed + 1)
+        self.train_step = None
+        self.eval_step = None
+        self._train_prefetcher = None
+        self._train_iter = None
+        self._pending: list = []
+
+    def build_data(self):
+        return WGANCifar_data(data_dir=self.config.data_dir,
+                              seed=self.config.seed)
+
+    # -- the fused WGAN round ------------------------------------------------
+
+    def compile_iter_fns(self, sync_type: str = "avg") -> None:
+        gen, critic = self.generator, self.critic
+        gen_tx, critic_tx = self.gen_tx, self.critic_tx
+        n_critic, clip_c, latent = self.n_critic, self.clip_c, self.latent_dim
+
+        def pmean(t):
+            return jax.tree.map(lambda x: jax.lax.pmean(x, AXIS_DATA), t)
+
+        def critic_loss(cp, gp, x_real, z):
+            x_fake = gen.apply({"params": gp}, z)
+            f_fake = critic.apply({"params": cp}, x_fake)
+            f_real = critic.apply({"params": cp}, x_real)
+            return jnp.mean(f_fake) - jnp.mean(f_real)
+
+        def gen_loss(gp, cp, z):
+            x_fake = gen.apply({"params": gp}, z)
+            return -jnp.mean(critic.apply({"params": cp}, x_fake))
+
+        def shard_step(state: WGANState, batch, rng):
+            x_real = batch[0]
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
+            c_rngs = jax.random.split(jax.random.fold_in(rng, 0), n_critic)
+            g_rng = jax.random.fold_in(rng, 1)
+            # each critic update sees a fresh real minibatch (WGAN
+            # recipe): split the shard's n_critic*b rows into slices
+            b = x_real.shape[0] // n_critic
+            x_slices = x_real[:b * n_critic].reshape(
+                (n_critic, b) + x_real.shape[1:])
+
+            def critic_iter(carry, inp):
+                cp, copt = carry
+                c_rng, x_slice = inp
+                z = jax.random.normal(c_rng, (b, latent))
+                loss, grads = jax.value_and_grad(critic_loss)(
+                    cp, state.gen_params, x_slice, z)
+                grads = pmean(grads)
+                updates, copt = critic_tx.update(grads, copt, cp)
+                cp = clip_params(optax.apply_updates(cp, updates), clip_c)
+                return (cp, copt), loss
+
+            (cp, copt), c_losses = jax.lax.scan(
+                critic_iter, (state.critic_params, state.critic_opt),
+                (c_rngs, x_slices))
+
+            z = jax.random.normal(g_rng, (b, latent))
+            g_loss_val, g_grads = jax.value_and_grad(gen_loss)(
+                state.gen_params, cp, z)
+            g_grads = pmean(g_grads)
+            g_updates, gopt = gen_tx.update(g_grads, state.gen_opt,
+                                            state.gen_params)
+            gp = optax.apply_updates(state.gen_params, g_updates)
+
+            # W-distance estimate = −(last critic loss); both pmean-ed
+            metrics = pmean({"loss": -c_losses[-1], "error": g_loss_val})
+            new_state = WGANState(step=state.step + 1, gen_params=gp,
+                                  gen_opt=gopt, critic_params=cp,
+                                  critic_opt=copt)
+            return new_state, metrics
+
+        sharded = jax.shard_map(shard_step, mesh=self.mesh,
+                                in_specs=(P(), P(AXIS_DATA), P()),
+                                out_specs=(P(), P()), check_vma=False)
+        self.train_step = jax.jit(sharded, donate_argnums=(0,))
+
+        def eval_shard(state: WGANState, batch, rng):
+            x_real = batch[0]
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
+            z = jax.random.normal(rng, (x_real.shape[0], latent))
+            w = -critic_loss(state.critic_params, state.gen_params, x_real, z)
+            return pmean({"loss": w, "error": jnp.zeros(())})
+
+        eval_sharded = jax.shard_map(eval_shard, mesh=self.mesh,
+                                     in_specs=(P(), P(AXIS_DATA), P()),
+                                     out_specs=P(), check_vma=False)
+        self.eval_step = jax.jit(eval_sharded)
+
+    def val_iter(self, count: int, recorder: Recorder, batch=None) -> dict:
+        return self.eval_step(self.state, batch, self._next_rng())
+
+    def generate(self, n: int, seed: int = 0) -> np.ndarray:
+        """Sample n images from the generator (host-side convenience)."""
+        z = jax.random.normal(jax.random.key(seed), (n, self.latent_dim))
+        x = self.generator.apply({"params": self.state.gen_params}, z)
+        return np.asarray(x)
+
+    # -- contract odds and ends for the two-network state --------------------
+
+    @property
+    def params(self) -> PyTree:
+        return {"generator": self.state.gen_params,
+                "critic": self.state.critic_params}
+
+    def adjust_hyperp(self, epoch: int) -> float:
+        return self._base_lr  # WGAN: constant RMSprop LR
+
+    def save(self, path: str | None = None) -> str:
+        path = path or os.path.join(self.config.snapshot_dir,
+                                    f"{self.name}_params.npz")
+        save_params_npz(path, self.params)
+        return path
+
+    def load(self, path: str) -> None:
+        like = jax.tree.map(np.asarray, self.params)
+        loaded = load_params_npz(path, like)
+        loaded = jax.tree.map(jnp.asarray, loaded)
+        self.state = self.state.replace(
+            gen_params=replicate(loaded["generator"], self.mesh),
+            critic_params=replicate(loaded["critic"], self.mesh),
+        )
