@@ -1,0 +1,99 @@
+"""Sequence/context parallelism: ring / all-gather / ulysses attention
+sharded over the 'seq' mesh axis must match single-device attention —
+values AND gradients — on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.mesh import AXIS_SEQ, MeshSpec, make_training_mesh
+from theanompi_tpu.parallel.sequence import (
+    attention_reference,
+    sequence_attention,
+)
+
+B, T, H, D = 2, 32, 8, 16      # T shards 8 ways -> T_local = 4
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    devs = jax.devices()[:8]
+    return make_training_mesh(MeshSpec(data=1, seq=8), devs)
+
+
+def make_qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(
+        jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
+        for _ in range(3)
+    )
+
+
+def sharded_attn(mesh, strategy, causal):
+    spec = P(None, AXIS_SEQ, None, None)
+
+    def fn(q, k, v):
+        return sequence_attention(q, k, v, causal=causal, strategy=strategy)
+
+    return jax.jit(jax.shard_map(fn, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False))
+
+
+@pytest.mark.parametrize("strategy", ["ring", "allgather", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_reference(seq_mesh, strategy, causal):
+    q, k, v = make_qkv()
+    want = attention_reference(q, k, v, causal=causal)
+    got = sharded_attn(seq_mesh, strategy, causal)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "allgather", "ulysses"])
+def test_gradients_match_reference(seq_mesh, strategy):
+    q, k, v = make_qkv(1)
+    ct = jnp.asarray(np.random.RandomState(2).randn(B, T, H, D)
+                     .astype(np.float32))
+
+    def loss_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=True) * ct).sum()
+
+    attn = sharded_attn(seq_mesh, strategy, causal=True)
+
+    def loss_sp(q, k, v):
+        return (attn(q, k, v) * ct).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_sp, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-6)
+
+
+def test_ulysses_rejects_bad_heads(seq_mesh):
+    q = k = v = jnp.zeros((1, 16, 6, 4))  # 6 heads not divisible by 8
+    attn = sharded_attn(seq_mesh, "ulysses", causal=False)
+    with pytest.raises(ValueError, match="divisible"):
+        attn(q, k, v)
+
+
+def test_unknown_strategy_rejected():
+    with pytest.raises(ValueError, match="unknown sequence-parallel"):
+        sequence_attention(jnp.zeros((1, 4, 2, 2)), jnp.zeros((1, 4, 2, 2)),
+                           jnp.zeros((1, 4, 2, 2)), strategy="nccl")
+
+
+def test_ring_long_context_memory_shape(seq_mesh):
+    # the point of the ring: per-device K/V residency is T/n — check
+    # the op runs at a T where full T x T scores per device would be
+    # 8x the blockwise working set (smoke, not a memory assertion)
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(1, 256, 4, 8).astype(np.float32))
+               for _ in range(3))
+    got = sharded_attn(seq_mesh, "ring", causal=True)(q, k, v)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)

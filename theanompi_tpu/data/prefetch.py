@@ -33,8 +33,10 @@ class DevicePrefetcher:
 
     _SENTINEL = object()
 
-    def __init__(self, host_batches: Iterable, mesh, depth: int = 2):
+    def __init__(self, host_batches: Iterable, mesh, depth: int = 2,
+                 spec=None):
         self.mesh = mesh
+        self.spec = spec  # PartitionSpec override (default: data axis)
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err: BaseException | None = None
@@ -48,7 +50,7 @@ class DevicePrefetcher:
             for batch in it:
                 if self._stop.is_set():
                     return
-                staged = shard_batch(batch, self.mesh)
+                staged = shard_batch(batch, self.mesh, self.spec)
                 while not self._stop.is_set():
                     try:
                         self._q.put(staged, timeout=0.1)

@@ -12,6 +12,8 @@ MODEL_ZOO = {
     "vgg16": ("theanompi_tpu.models.vgg16", "VGG16"),
     "resnet50": ("theanompi_tpu.models.resnet50", "ResNet50"),
     "wgan": ("theanompi_tpu.models.wasserstein_gan", "Wasserstein_GAN"),
+    # beyond reference parity: long-context sequence-parallel LM
+    "transformer_lm": ("theanompi_tpu.models.transformer", "TransformerLM"),
     # zoo variants (reference lasagne_model_zoo equivalents)
     "vgg19": ("theanompi_tpu.models.model_zoo", "VGG19"),
     "resnet101": ("theanompi_tpu.models.model_zoo", "ResNet101"),

@@ -95,10 +95,39 @@ class TpuModel:
     """Base model implementing the reference contract over the BSP spine."""
 
     name = "model"
+    #: how batches land on the mesh; None = leading dim over 'data'.
+    #: Sequence-parallel models override (e.g. P('data', 'seq')).
+    batch_partition = None
 
     def __init__(self, config: ModelConfig | None = None, mesh=None,
                  verbose: bool = True, shard_rank: int = 0,
                  shard_size: int = 1, data: Dataset | None = None):
+        self._init_scaffold(config, mesh, verbose, shard_rank, shard_size,
+                            data)
+        self.module: nn.Module = self.build_module()
+
+        rng = jax.random.key(self.config.seed)
+        dummy = jnp.zeros((2, *self.data.sample_shape), self._input_dtype())
+        # init traces the TRAINING path so train-only parameters (e.g.
+        # GoogLeNet's aux heads) are created; flax skips running-stat
+        # writes while initializing, so BN state stays at its init values
+        variables = self.module.init({"params": rng, "dropout": rng}, dummy,
+                                     train=True)
+        variables = dict(variables)
+        params = variables.pop("params")
+        model_state = variables  # e.g. {'batch_stats': ...} or {}
+
+        self.tx = self._build_optimizer(self._base_lr)
+        state = TrainState.create(params, self.tx, model_state)
+        self.state = replicate(state, self.mesh)
+
+    def _init_scaffold(self, config, mesh, verbose, shard_rank, shard_size,
+                       data) -> None:
+        """The contract scaffolding shared by every model — including
+        ones (WGAN) whose network/optimizer state diverges from the
+        single-module TrainState path: mesh/shard bookkeeping, dataset,
+        worker-scaled LR, rng, and the train-loop fields that
+        ``begin_epoch``/``train_iter``/``_flush_metrics`` rely on."""
         self.config = config or self.default_config()
         self.verbose = verbose
         self.mesh = mesh if mesh is not None else data_mesh()
@@ -117,28 +146,12 @@ class TpuModel:
         # ``data`` lets N worker models in one process (async rules)
         # share one Dataset instead of loading N copies
         self.data: Dataset = data if data is not None else self.build_data()
-        self.module: nn.Module = self.build_module()
 
         base_lr = self.config.learning_rate
         if self.config.lr_scale_with_workers:
             base_lr = scale_lr(base_lr, self.n_workers,
                                self.config.lr_scale_with_workers)
         self._base_lr = base_lr
-
-        rng = jax.random.key(self.config.seed)
-        dummy = jnp.zeros((2, *self.data.sample_shape), self._input_dtype())
-        # init traces the TRAINING path so train-only parameters (e.g.
-        # GoogLeNet's aux heads) are created; flax skips running-stat
-        # writes while initializing, so BN state stays at its init values
-        variables = self.module.init({"params": rng, "dropout": rng}, dummy,
-                                     train=True)
-        variables = dict(variables)
-        params = variables.pop("params")
-        model_state = variables  # e.g. {'batch_stats': ...} or {}
-
-        self.tx = self._build_optimizer(base_lr)
-        state = TrainState.create(params, self.tx, model_state)
-        self.state = replicate(state, self.mesh)
 
         self._rng = jax.random.key(self.config.seed + 1)
         self.train_step = None
@@ -228,17 +241,42 @@ class TpuModel:
     def params(self) -> PyTree:
         return self.state.params
 
+    def _batch_axes(self) -> tuple:
+        """(partition, reduce_axes) derived from ``batch_partition`` —
+        every mesh axis the batch is sharded over is also a gradient/
+        metric reduce axis, so a subclass setting the attribute gets a
+        consistent step with no extra plumbing."""
+        from jax.sharding import PartitionSpec as P
+
+        from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+        part = (self.batch_partition if self.batch_partition is not None
+                else P(AXIS_DATA))
+        axes = []
+        for entry in part:
+            if entry is None:
+                continue
+            for a in (entry,) if isinstance(entry, str) else entry:
+                axes.append(a)
+        return part, tuple(axes)
+
     def compile_iter_fns(self, sync_type: str = "avg") -> None:
         """Build the jitted SPMD steps (the reference's Theano-function
         compile; ``sync_type`` 'avg' vs 'cdd' maps to exchange avg/sum)."""
+        part, axes = self._batch_axes()
         exchanger = BSP_Exchanger(
             strategy=self.config.exchange_strategy,
             avg=(sync_type != "cdd"),
             exchange_what=self.config.exchange_what,
+            axis=axes if len(axes) > 1 else axes[0],
         )
         self.train_step = make_bsp_train_step(self.loss_fn, self.tx,
-                                              self.mesh, exchanger)
-        self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh)
+                                              self.mesh, exchanger,
+                                              batch_partition=part,
+                                              reduce_axes=axes)
+        self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
+                                            batch_partition=part,
+                                            reduce_axes=axes)
 
     def compile_grad_fn(self):
         """Jitted gradient-only step for parameter-server rules (ASGD):
@@ -261,7 +299,8 @@ class TpuModel:
         self.current_epoch = epoch
         host_iter = self.data.train_batches(epoch, self.global_batch,
                                             self.shard_rank, self.shard_size)
-        self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh)
+        self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh,
+                                                  spec=self.batch_partition)
         self._train_iter = iter(self._train_prefetcher)
         return self.data.n_train_batches_for(epoch, self.global_batch,
                                              self.shard_rank, self.shard_size)
@@ -314,7 +353,8 @@ class TpuModel:
         sums: dict[str, float] = {}
         n = 0
         host_iter = self.data.val_batches(self.global_batch)
-        with DevicePrefetcher(host_iter, self.mesh) as pf:
+        with DevicePrefetcher(host_iter, self.mesh,
+                              spec=self.batch_partition) as pf:
             for batch in pf:
                 m = self.val_iter(n, recorder, batch)
                 for k, v in m.items():

@@ -1,0 +1,169 @@
+"""Causal transformer LM — the long-context / sequence-parallel
+flagship.
+
+Not a reference-parity model (the reference predates attention,
+SURVEY.md §2.11/§5.7); this is the model family that exercises the
+framework's first-class long-context path: the TIME dimension is
+sharded over the mesh's ``seq`` axis and attention runs via
+``parallel.sequence`` (ring / all-gather / ulysses), so context length
+scales with chips.  Everything else rides the same spine as the CNN
+zoo — the model keeps the full reference contract and trains through
+``run_bsp_session`` with the batch sharded ``P('data', 'seq')`` and
+gradients exchanged over BOTH axes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.data.lm import SeqLM_data
+from theanompi_tpu.models import layers as L
+from theanompi_tpu.models.base import ModelConfig, TpuModel
+from theanompi_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
+from theanompi_tpu.parallel.sequence import (
+    attention_reference,
+    sequence_attention,
+)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block with sequence-parallel attention."""
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    sp_strategy: str = "ring"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, seq_axis: str | None = None):
+        b, t, _ = x.shape
+        d_head = self.d_model // self.n_heads
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False,
+                       kernel_init=L.xavier_init(), dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, self.n_heads, d_head)
+        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        if seq_axis is not None:
+            o = sequence_attention(q, k, v, axis_name=seq_axis, causal=True,
+                                   strategy=self.sp_strategy)
+        else:
+            o = attention_reference(q, k, v, causal=True)
+        o = o.reshape((b, t, self.d_model))
+        x = x + nn.Dense(self.d_model, use_bias=False,
+                         kernel_init=L.xavier_init(), dtype=self.dtype)(o)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.d_ff, kernel_init=L.he_init(), dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, kernel_init=L.xavier_init(),
+                         dtype=self.dtype)(h)
+        return x
+
+
+class TransformerLMNet(nn.Module):
+    """Token ids (B, T_local) -> logits (B, T_local, vocab).
+
+    ``seq_axis`` is a CALL-time argument (not a module field) so the
+    same parameters serve both the sharded training path (inside
+    shard_map, where positions offset by the shard index) and
+    unsharded init/inference.
+    """
+
+    vocab: int = 256
+    n_layers: int = 2
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    max_len: int = 2048
+    sp_strategy: str = "ring"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False,
+                 seq_axis: str | None = None):
+        t_local = tokens.shape[1]
+        offset = (lax.axis_index(seq_axis) * t_local
+                  if seq_axis is not None else 0)
+        x = nn.Embed(self.vocab, self.d_model,
+                     embedding_init=L.gaussian_init(0.02))(tokens)
+        pos_emb = self.param("pos_emb", L.gaussian_init(0.02),
+                             (self.max_len, self.d_model))
+        x = x + lax.dynamic_slice_in_dim(pos_emb, offset, t_local)[None]
+        x = x.astype(self.dtype)
+        for _ in range(self.n_layers):
+            x = Block(self.d_model, self.n_heads, self.d_ff,
+                      self.sp_strategy, self.dtype)(x, seq_axis=seq_axis)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab, kernel_init=L.xavier_init(),
+                          dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+class TransformerLM(TpuModel):
+    """LM over (data x seq)-sharded batches; reference model contract."""
+
+    name = "transformer_lm"
+    sp_strategy = "ring"
+    batch_partition = P(AXIS_DATA, AXIS_SEQ)   # (B, T) over (data, seq)
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return ModelConfig(
+            batch_size=16,
+            n_epochs=5,
+            learning_rate=0.1,
+            momentum=0.9,
+            weight_decay=0.0,
+            lr_schedule="constant",
+            print_freq=20,
+        )
+
+    def __init__(self, *args, vocab: int = 256, seq_len: int = 128,
+                 n_layers: int = 2, d_model: int = 128, n_heads: int = 4,
+                 **kwargs):
+        self._net_cfg = dict(vocab=vocab, seq_len=seq_len, n_layers=n_layers,
+                             d_model=d_model, n_heads=n_heads)
+        super().__init__(*args, **kwargs)
+
+    def _input_dtype(self):
+        return jnp.int32
+
+    def build_data(self):
+        c = self._net_cfg
+        return SeqLM_data(vocab=c["vocab"], seq_len=c["seq_len"],
+                          seed=self.config.seed)
+
+    def build_module(self) -> nn.Module:
+        c = self._net_cfg
+        return TransformerLMNet(
+            vocab=c["vocab"], n_layers=c["n_layers"], d_model=c["d_model"],
+            n_heads=c["n_heads"], d_ff=4 * c["d_model"],
+            max_len=max(2048, c["seq_len"]), sp_strategy=self.sp_strategy,
+            dtype=self._compute_dtype())
+
+    # -- (data x seq) SPMD wiring -------------------------------------------
+
+    def loss_fn(self, params, model_state, batch, rng):
+        tokens, targets = batch
+        logits = self.module.apply({"params": params}, tokens, train=True,
+                                   seq_axis=AXIS_SEQ, rngs={"dropout": rng})
+        v = logits.shape[-1]
+        loss = L.softmax_cross_entropy(logits.reshape(-1, v),
+                                       targets.reshape(-1))
+        err = L.error_rate(logits.reshape(-1, v), targets.reshape(-1))
+        return loss, (model_state, {"loss": loss, "error": err})
+
+    def eval_fn(self, params, model_state, batch):
+        tokens, targets = batch
+        logits = self.module.apply({"params": params}, tokens, train=False,
+                                   seq_axis=AXIS_SEQ)
+        v = logits.shape[-1]
+        return {"loss": L.softmax_cross_entropy(logits.reshape(-1, v),
+                                                targets.reshape(-1)),
+                "error": L.error_rate(logits.reshape(-1, v),
+                                      targets.reshape(-1))}
+

@@ -38,11 +38,7 @@ from theanompi_tpu.data.cifar10 import Cifar10_data
 from theanompi_tpu.models import layers as L
 from theanompi_tpu.models.base import ModelConfig, TpuModel
 from theanompi_tpu.parallel.mesh import AXIS_DATA, replicate
-from theanompi_tpu.utils.helper_funcs import (
-    load_params_npz,
-    save_params_npz,
-    scale_lr,
-)
+from theanompi_tpu.utils.helper_funcs import load_params_npz, save_params_npz
 from theanompi_tpu.utils.recorder import Recorder
 
 PyTree = Any
@@ -137,26 +133,15 @@ class Wasserstein_GAN(TpuModel):
     def __init__(self, config: ModelConfig | None = None, mesh=None,
                  verbose: bool = True, shard_rank: int = 0,
                  shard_size: int = 1, data=None, width: int = 64):
-        # two-network state: rebuild the base scaffolding around a
-        # (generator, critic) pair instead of calling TpuModel.__init__
-        from theanompi_tpu.parallel.mesh import data_axis_size, data_mesh
-
-        self.config = config or self.default_config()
-        self.verbose = verbose
-        self.mesh = mesh if mesh is not None else data_mesh()
-        self.n_workers = data_axis_size(self.mesh)
-        self.shard_rank = shard_rank
-        self.shard_size = shard_size
-        self.batch_size = self.config.batch_size
+        # shared contract scaffolding, then the two-network state the
+        # single-module TrainState path can't express
+        self._init_scaffold(config, mesh, verbose, shard_rank, shard_size,
+                            data)
         # one fused round consumes a FRESH real minibatch per critic
         # update (the WGAN recipe) plus none for the generator, so the
         # data pipeline feeds n_critic * batch_size images per step
         self.global_batch = self.batch_size * self.n_workers * self.n_critic
-        self.n_epochs = self.config.n_epochs
-        self.current_epoch = 0
-        self.current_info: dict = {}
 
-        self.data = data if data is not None else self.build_data()
         dtype = self._compute_dtype()
         self.generator = Generator(width=width * 2, dtype=dtype)
         self.critic = Critic(width=width * 2, dtype=dtype)
@@ -169,11 +154,6 @@ class Wasserstein_GAN(TpuModel):
         gen_params = self.generator.init(g_rng, z)["params"]
         critic_params = self.critic.init(c_rng, x)["params"]
 
-        base_lr = self.config.learning_rate
-        if self.config.lr_scale_with_workers:
-            base_lr = scale_lr(base_lr, self.n_workers,
-                               self.config.lr_scale_with_workers)
-        self._base_lr = base_lr
         self.gen_tx = optax.rmsprop(self._base_lr)
         self.critic_tx = optax.rmsprop(self._base_lr)
 
@@ -186,13 +166,6 @@ class Wasserstein_GAN(TpuModel):
         )
         self.state = replicate(state, self.mesh)
 
-        self._rng = jax.random.key(self.config.seed + 1)
-        self.train_step = None
-        self.eval_step = None
-        self._train_prefetcher = None
-        self._train_iter = None
-        self._pending: list = []
-
     def build_data(self):
         return WGANCifar_data(data_dir=self.config.data_dir,
                               seed=self.config.seed)
@@ -203,6 +176,16 @@ class Wasserstein_GAN(TpuModel):
         gen, critic = self.generator, self.critic
         gen_tx, critic_tx = self.gen_tx, self.critic_tx
         n_critic, clip_c, latent = self.n_critic, self.clip_c, self.latent_dim
+        # gradient exchange honors the same strategy/sync knobs as every
+        # other model ('cdd' = sum with caller-pre-scaled LR; 'nccl16'
+        # etc. = bf16-compressed exchange)
+        from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+        exchanger = BSP_Exchanger(
+            strategy=self.config.exchange_strategy,
+            avg=(sync_type != "cdd"),
+            exchange_what="grads",
+        )
 
         def pmean(t):
             return jax.tree.map(lambda x: jax.lax.pmean(x, AXIS_DATA), t)
@@ -234,7 +217,7 @@ class Wasserstein_GAN(TpuModel):
                 z = jax.random.normal(c_rng, (b, latent))
                 loss, grads = jax.value_and_grad(critic_loss)(
                     cp, state.gen_params, x_slice, z)
-                grads = pmean(grads)
+                grads = exchanger.exchange(grads)
                 updates, copt = critic_tx.update(grads, copt, cp)
                 cp = clip_params(optax.apply_updates(cp, updates), clip_c)
                 return (cp, copt), loss
@@ -246,7 +229,7 @@ class Wasserstein_GAN(TpuModel):
             z = jax.random.normal(g_rng, (b, latent))
             g_loss_val, g_grads = jax.value_and_grad(gen_loss)(
                 state.gen_params, cp, z)
-            g_grads = pmean(g_grads)
+            g_grads = exchanger.exchange(g_grads)
             g_updates, gopt = gen_tx.update(g_grads, state.gen_opt,
                                             state.gen_params)
             gp = optax.apply_updates(state.gen_params, g_updates)

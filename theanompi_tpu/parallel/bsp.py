@@ -52,8 +52,8 @@ class TrainState:
         )
 
 
-def _pmean(tree: PyTree) -> PyTree:
-    return jax.tree.map(lambda x: jax.lax.pmean(x, AXIS_DATA), tree)
+def _pmean(tree: PyTree, axes=(AXIS_DATA,)) -> PyTree:
+    return jax.tree.map(lambda x: jax.lax.pmean(x, axes), tree)
 
 
 def make_bsp_train_step(
@@ -62,18 +62,25 @@ def make_bsp_train_step(
     mesh: jax.sharding.Mesh,
     exchanger: BSP_Exchanger | None = None,
     donate: bool = True,
+    batch_partition: P = P(AXIS_DATA),
+    reduce_axes: tuple[str, ...] = (AXIS_DATA,),
 ):
     """Build the jitted SPMD training step.
 
     Returns ``step(state, batch, rng) -> (state, metrics)`` where
     ``state`` is replicated over the mesh, ``batch`` is a pytree whose
-    leading dim is sharded over the ``data`` axis, and ``rng`` is a
-    replicated key (folded per-shard inside for dropout decorrelation).
+    arrays are sharded by ``batch_partition`` (default: leading dim
+    over the ``data`` axis; a sequence-parallel step passes
+    ``P('data', 'seq')`` with ``reduce_axes=('data', 'seq')``), and
+    ``rng`` is a replicated key (folded per-shard inside for dropout
+    decorrelation).
     """
-    exchanger = exchanger or BSP_Exchanger()
+    exchanger = exchanger or BSP_Exchanger(
+        axis=reduce_axes if len(reduce_axes) > 1 else reduce_axes[0])
 
     def shard_step(state: TrainState, batch, rng):
-        rng = jax.random.fold_in(rng, jax.lax.axis_index(AXIS_DATA))
+        for ax in reduce_axes:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         (loss, (new_ms, metrics)), grads = grad_fn(
             state.params, state.model_state, batch, rng
@@ -97,12 +104,12 @@ def make_bsp_train_step(
             # them too so state stays replicated (matches the reference's
             # param-averaging BSP semantics closely enough, and keeps the
             # SPMD invariant that state is identical on every shard).
-            new_opt = _pmean(new_opt)
+            new_opt = _pmean(new_opt, reduce_axes)
 
         # Cross-replica sync of mutable collections (BN batch_stats):
         # each shard saw a different micro-batch; average the stats.
-        new_ms = _pmean(new_ms)
-        metrics = _pmean(metrics)
+        new_ms = _pmean(new_ms, reduce_axes)
+        metrics = _pmean(metrics, reduce_axes)
 
         return (
             TrainState(
@@ -117,7 +124,7 @@ def make_bsp_train_step(
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(P(), P(AXIS_DATA), P()),
+        in_specs=(P(), batch_partition, P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -127,22 +134,24 @@ def make_bsp_train_step(
 def make_bsp_eval_step(
     eval_fn: Callable[[PyTree, PyTree, PyTree], dict],
     mesh: jax.sharding.Mesh,
+    batch_partition: P = P(AXIS_DATA),
+    reduce_axes: tuple[str, ...] = (AXIS_DATA,),
 ):
     """Build the jitted SPMD eval step.
 
     ``eval_fn(params, model_state, batch) -> metrics`` runs per shard;
-    metrics are pmean-ed over the data axis (the reference allreduced
+    metrics are pmean-ed over the reduce axes (the reference allreduced
     val metrics the same way, SURVEY.md §3.5).
     """
 
     def shard_step(state: TrainState, batch):
         metrics = eval_fn(state.params, state.model_state, batch)
-        return _pmean(metrics)
+        return _pmean(metrics, reduce_axes)
 
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(P(), P(AXIS_DATA)),
+        in_specs=(P(), batch_partition),
         out_specs=P(),
         check_vma=False,
     )

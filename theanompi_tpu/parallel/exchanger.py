@@ -77,13 +77,15 @@ class BSP_Exchanger:
         the reference's alternative BSP mode).
       fp16_scale: kept for parity with the reference's fp16 strategies;
         bf16 needs no scaling, default 1.0.
+      axis: mesh axis name (or tuple of names) to reduce over — a
+        data x seq training step exchanges over both axes.
     """
 
     strategy: str = "psum"
     avg: bool = True
     exchange_what: str = "grads"
     fp16_scale: float = 1.0
-    axis: str = AXIS_DATA
+    axis: str | tuple[str, ...] = AXIS_DATA
 
     def __post_init__(self):
         if self.strategy not in _STRATEGY_ALIASES:
@@ -117,7 +119,10 @@ class BSP_Exchanger:
 
         out = jax.tree.map(reduce_leaf, tree)
         if self.avg:
-            n = jax.lax.axis_size(axis)
+            axes = (axis,) if isinstance(axis, str) else tuple(axis)
+            n = 1
+            for a in axes:
+                n *= jax.lax.axis_size(a)
             out = jax.tree.map(lambda x: x / n, out)
         return out
 

@@ -128,15 +128,16 @@ def local_batch(global_batch: int, mesh: Mesh) -> int:
     return global_batch // n
 
 
-def shard_batch(batch, mesh: Mesh):
+def shard_batch(batch, mesh: Mesh, spec: P | None = None):
     """Place a host batch (pytree of arrays with a leading batch dim)
-    onto the mesh, sharded over the data axis.
+    onto the mesh, sharded over the data axis (or an explicit ``spec``
+    — e.g. ``P('data', 'seq')`` for time-sharded LM batches).
 
     The moral equivalent of the reference's per-rank H2D staging of its
     data shard (SURVEY.md §3.4) — here a single ``device_put`` with a
     NamedSharding splits the global batch across chips.
     """
-    sh = batch_sharding(mesh)
+    sh = NamedSharding(mesh, spec if spec is not None else batch_spec(mesh))
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
 
 

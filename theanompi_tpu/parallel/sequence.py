@@ -1,0 +1,186 @@
+"""Sequence/context parallelism — ring attention + all-to-all variants.
+
+The reference predates attention entirely (SURVEY.md §2.11/§5.7: a
+2016 CNN framework; its only "sequence length" story is image
+resolution).  The TPU rebuild makes long-context a first-class axis
+anyway: the mesh reserves ``seq`` (parallel/mesh.py), and this module
+supplies the attention primitives that shard the TIME dimension across
+devices, so context length scales with chips instead of HBM.
+
+Three strategies, all pure SPMD collectives over ICI (used inside a
+``shard_map`` whose inputs are time-sharded ``P(..., 'seq', ...)``):
+
+* ``ring_attention`` — blockwise attention with the online-softmax
+  (flash) accumulation; K/V blocks rotate around the ring via
+  ``lax.ppermute`` while each device keeps only its Q shard resident.
+  Memory per device is O(T/n); the n ppermute hops ride ICI and XLA
+  overlaps them with the per-block einsums.  Causal masking uses
+  global positions, so rotation order never changes semantics.
+* ``allgather_attention`` — K/V ``all_gather`` over the seq axis, then
+  ordinary attention against the local Q shard.  Simplest; memory
+  O(T) for K/V but still O(T/n) for scores if T_local is small.
+* ``ulysses_attention`` — the all-to-all layout swap: resharding
+  (time-sharded, all heads) → (all time, head-sharded) around a plain
+  local attention, then back.  Needs n_heads % n_seq == 0.
+
+All take/return (B, T_local, H, D) and are differentiable (the ring
+loop is a ``lax.scan``), so they drop into a jitted training step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import AXIS_SEQ
+
+# large-negative mask value: finite so the online-softmax accumulator
+# never produces inf-inf=nan; exp(-1e30 - m) underflows to exactly 0
+# once any real score is seen, wiping masked contributions
+_MASK_NEG = -1e30
+
+
+def _block_scores(q, k, scale):
+    # q (B,Tq,H,D) x k (B,Tk,H,D) -> (B,H,Tq,Tk); fp32 accumulation
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _causal_mask(q_pos, k_pos):
+    return q_pos[:, None] >= k_pos[None, :]          # (Tq, Tk)
+
+
+def attention_reference(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Plain single-device attention (the correctness oracle and the
+    inner kernel of the non-ring strategies)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = _block_scores(q, k, scale)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = _causal_mask(jnp.arange(tq), jnp.arange(tk))
+        s = jnp.where(mask[None, None], s, _MASK_NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _attention_positions(q, k, v, q_pos, k_pos, scale):
+    """Masked attention with explicit global positions (causal)."""
+    s = _block_scores(q, k, scale)
+    s = jnp.where(_causal_mask(q_pos, k_pos)[None, None], s, _MASK_NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def ring_attention(q, k, v, axis_name: str = AXIS_SEQ,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Blockwise ring attention over the ``axis_name`` mesh axis.
+
+    Inputs are the local time shard (B, T_local, H, D), laid out so
+    shard i holds global positions [i*T_local, (i+1)*T_local).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    q_pos = idx * t_local + jnp.arange(t_local)
+
+    m0 = jnp.full((b, h, t_local), _MASK_NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        k_blk, v_blk, m, l, acc = carry
+        # after `step` rotations this device holds the block that
+        # originated on ring neighbour (idx - step)
+        src = (idx - step) % n
+        k_pos = src * t_local + jnp.arange(t_local)
+        s = _block_scores(q, k_blk, scale)            # (B,H,Tq,Tk)
+        if causal:
+            s = jnp.where(_causal_mask(q_pos, k_pos)[None, None],
+                          s, _MASK_NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m_new, l, acc), None
+
+    (k_f, v_f, m, l, acc), _ = lax.scan(
+        body, (k, v, m0, l0, acc0), jnp.arange(n))
+    del k_f, v_f
+    out = acc / l[..., None]                          # (B,H,Tq,D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B,Tq,H,D)
+
+
+def allgather_attention(q, k, v, axis_name: str = AXIS_SEQ,
+                        causal: bool = False,
+                        scale: Optional[float] = None):
+    """K/V all-gathered over the seq axis, local Q shard attends."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    k_full = lax.all_gather(k, axis_name, axis=1, tiled=True)
+    v_full = lax.all_gather(v, axis_name, axis=1, tiled=True)
+    if not causal:
+        s = _block_scores(q, k_full, scale)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v_full)
+    q_pos = idx * t_local + jnp.arange(t_local)
+    k_pos = jnp.arange(n * t_local)
+    return _attention_positions(q, k_full, v_full, q_pos, k_pos, scale)
+
+
+def ulysses_attention(q, k, v, axis_name: str = AXIS_SEQ,
+                      causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all head/time reshard around a plain local attention
+    (the DeepSpeed-Ulysses layout): (B, T/n, H, D) -> (B, T, H/n, D)
+    -> attend -> back.  Requires H % n == 0."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by seq axis ({n})")
+
+    def to_headshard(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_timeshard(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_headshard(q), to_headshard(k), to_headshard(v)
+    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    return to_timeshard(out)
+
+
+STRATEGIES = {
+    "ring": ring_attention,
+    "allgather": allgather_attention,
+    "ulysses": ulysses_attention,
+}
+
+
+def sequence_attention(q, k, v, axis_name: str = AXIS_SEQ,
+                       causal: bool = False,
+                       scale: Optional[float] = None,
+                       strategy: str = "ring"):
+    """Dispatch on the SP strategy name (the async-exchanger-style
+    strategy seam, kept string-keyed like the reference's exchanger
+    strategies — SURVEY.md §2.4)."""
+    try:
+        fn = STRATEGIES[strategy]
+    except KeyError:
+        raise ValueError(
+            f"unknown sequence-parallel strategy {strategy!r}; "
+            f"available: {sorted(STRATEGIES)}") from None
+    return fn(q, k, v, axis_name=axis_name, causal=causal, scale=scale)
