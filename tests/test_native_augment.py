@@ -1,0 +1,147 @@
+"""Native C++ fused augment vs the numpy oracle: identical randomness,
+matching values, reflect-pad and flip semantics, and graceful fallback
+(reference loader parity — SURVEY.md §2.9/§3.4)."""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu import native
+from theanompi_tpu.data.utils import augment_normalize, center_normalize
+
+needs_native = pytest.mark.skipif(not native.native_available(),
+                                  reason="native build unavailable")
+
+
+def batch(n=8, h=40, w=40, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, h, w, c)).astype(np.uint8)
+
+
+@needs_native
+class TestNativeMatchesNumpy:
+    def check(self, **kw):
+        x = batch()
+        # identical rng state for both paths -> identical crops/flips
+        got = augment_normalize(x, 32, 32, np.random.default_rng(7), **kw)
+        import theanompi_tpu.data.utils as U
+        orig = U._use_native
+        U._use_native = lambda images: False
+        try:
+            want = augment_normalize(x, 32, 32, np.random.default_rng(7),
+                                     **kw)
+        finally:
+            U._use_native = orig
+        assert got.dtype == want.dtype == np.float32
+        # bitwise: the kernel mirrors numpy's exact f32 op order, so
+        # training runs are independent of which impl decoded the batch
+        np.testing.assert_array_equal(got, want)
+
+    def test_plain_crop_flip(self):
+        self.check()
+
+    def test_with_normalization(self):
+        self.check(mean=(0.45, 0.46, 0.47), std=(0.2, 0.21, 0.22))
+
+    def test_reflect_pad(self):
+        self.check(pad=4, mean=(0.5,) * 3, std=(0.5,) * 3)
+
+    def test_no_flip(self):
+        self.check(flip=False)
+
+    def test_center_normalize(self):
+        x = batch(n=5)
+        got = center_normalize(x, 32, 32, mean=(0.4,) * 3, std=(0.3,) * 3)
+        import theanompi_tpu.data.utils as U
+        orig = U._use_native
+        U._use_native = lambda images: False
+        try:
+            want = center_normalize(x, 32, 32, mean=(0.4,) * 3,
+                                    std=(0.3,) * 3)
+        finally:
+            U._use_native = orig
+        np.testing.assert_array_equal(got, want)
+
+
+def test_fallback_on_float_input():
+    # float input can't take the native path; must still work
+    x = batch().astype(np.float32)
+    out = augment_normalize(x, 32, 32, np.random.default_rng(0), divisor=1.0)
+    assert out.shape == (8, 32, 32, 3) and out.dtype == np.float32
+
+
+def test_env_kill_switch():
+    # THEANOMPI_TPU_NATIVE=0 must disable the native path at load time;
+    # run in a subprocess because availability is cached per process
+    import subprocess
+    import sys
+    code = ("from theanompi_tpu import native; "
+            "assert not native.native_available(); print('off')")
+    env = dict(__import__('os').environ,
+               THEANOMPI_TPU_NATIVE="0", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0 and "off" in out.stdout, out.stderr
+
+
+def test_bad_inputs_rejected():
+    if not native.native_available():
+        pytest.skip("native build unavailable")
+    x = batch()
+    n = len(x)
+    ys = xs = np.zeros(n, np.int64)
+    flips = np.zeros(n, np.uint8)
+    with pytest.raises(ValueError, match="uint8"):
+        native.crop_flip_normalize(x.astype(np.float32), ys, xs, flips,
+                                   32, 32, np.zeros(3), np.ones(3))
+    with pytest.raises(ValueError, match="mean/std"):
+        native.crop_flip_normalize(x, ys, xs, flips, 32, 32,
+                                   np.zeros(1), np.ones(3))
+
+
+def test_center_normalize_rejects_undersized():
+    with pytest.raises(ValueError, match="smaller than crop"):
+        center_normalize(batch(h=16, w=16), 32, 32)
+
+
+@needs_native
+def test_dataset_batches_unchanged_by_native():
+    """Cifar batches must be identical whichever impl runs (the rng
+    draw order is part of the dataset's determinism contract)."""
+    from theanompi_tpu.data.cifar10 import Cifar10_data
+    import theanompi_tpu.data.utils as U
+
+    d = Cifar10_data(synthetic_n=256)
+    nat = [x for x, _ in d.train_batches(0, 64)]
+    orig = U._use_native
+    U._use_native = lambda images: False
+    try:
+        ref = [x for x, _ in d.train_batches(0, 64)]
+    finally:
+        U._use_native = orig
+    for a, b in zip(nat, ref):
+        np.testing.assert_array_equal(a, b)
+
+
+@needs_native
+def test_extreme_pad_reflect_matches_numpy():
+    # pad >= h-1 requires REPEATED reflection (np.pad semantics); the
+    # single-bounce version read out of bounds here
+    x = batch(n=4, h=4, w=4)
+    got = augment_normalize(x, 8, 8, np.random.default_rng(3), pad=4)
+    import theanompi_tpu.data.utils as U
+    orig = U._use_native
+    U._use_native = lambda images: False
+    try:
+        want = augment_normalize(x, 8, 8, np.random.default_rng(3), pad=4)
+    finally:
+        U._use_native = orig
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_out_of_range_origins_rejected():
+    x = batch(n=2)
+    with pytest.raises(ValueError, match="out of range"):
+        native.crop_flip_normalize(
+            x, np.array([0, 50], np.int64), np.zeros(2, np.int64),
+            np.zeros(2, np.uint8), 32, 32, np.zeros(3), np.ones(3))

@@ -1,9 +1,16 @@
 from theanompi_tpu.data.base import Batch, Dataset
 from theanompi_tpu.data.cifar10 import Cifar10_data
 from theanompi_tpu.data.prefetch import DevicePrefetcher
-from theanompi_tpu.data.utils import center_crop, normalize, random_crop_flip
+from theanompi_tpu.data.utils import (
+    augment_normalize,
+    center_crop,
+    center_normalize,
+    normalize,
+    random_crop_flip,
+)
 
 __all__ = [
     "Batch", "Dataset", "Cifar10_data", "DevicePrefetcher",
+    "augment_normalize", "center_normalize",
     "random_crop_flip", "center_crop", "normalize",
 ]

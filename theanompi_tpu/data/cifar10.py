@@ -22,7 +22,7 @@ from typing import Iterator
 import numpy as np
 
 from theanompi_tpu.data.base import Batch, Dataset
-from theanompi_tpu.data.utils import normalize, random_crop_flip
+from theanompi_tpu.data.utils import augment_normalize, center_normalize
 
 CIFAR_MEAN = (0.4914, 0.4822, 0.4465)
 CIFAR_STD = (0.2470, 0.2435, 0.2616)
@@ -112,9 +112,10 @@ class Cifar10_data(Dataset):
         if crop != 32:
             self.sample_shape = (crop, crop, 3)
 
-    def _prep(self, x: np.ndarray) -> np.ndarray:
-        # pixels are uint8 0..255; mean/std are in [0,1] units
-        return normalize(x.astype(np.float32) / 255.0, CIFAR_MEAN, CIFAR_STD)
+    #: normalization constants in [0,1] units; subclasses override
+    #: (e.g. the WGAN's tanh-range prep uses mean=std=0.5)
+    mean = CIFAR_MEAN
+    std = CIFAR_STD
 
     def train_batches(self, epoch: int, global_batch: int,
                       rank: int = 0, size: int = 1) -> Iterator[Batch]:
@@ -127,13 +128,15 @@ class Cifar10_data(Dataset):
         n = len(order) // global_batch
         for i in range(n):
             idx = order[i * global_batch:(i + 1) * global_batch]
-            x = random_crop_flip(self.x_train[idx], self.crop, self.crop,
-                                 aug_rng, pad=self.pad)
-            yield self._prep(x), self.y_train[idx]
+            x = augment_normalize(self.x_train[idx], self.crop, self.crop,
+                                  aug_rng, pad=self.pad, mean=self.mean,
+                                  std=self.std)
+            yield x, self.y_train[idx]
 
     def val_batches(self, global_batch: int,
                     rank: int = 0, size: int = 1) -> Iterator[Batch]:
         n = self.n_val_batches(global_batch)
         for i in range(n):
             sl = slice(i * global_batch, (i + 1) * global_batch)
-            yield self._prep(self.x_val[sl]), self.y_val[sl]
+            yield center_normalize(self.x_val[sl], self.crop, self.crop,
+                                   mean=self.mean, std=self.std), self.y_val[sl]

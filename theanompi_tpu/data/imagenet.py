@@ -40,7 +40,7 @@ from typing import Callable, Iterator, Sequence
 import numpy as np
 
 from theanompi_tpu.data.base import Batch, Dataset
-from theanompi_tpu.data.utils import center_crop, normalize, random_crop_flip
+from theanompi_tpu.data.utils import augment_normalize, center_normalize
 
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
@@ -185,9 +185,14 @@ class ImageNet_data(Dataset):
 
     # -- shared prep ---------------------------------------------------------
 
-    def _prep(self, x: np.ndarray) -> np.ndarray:
-        return normalize(x.astype(np.float32) / 255.0,
-                         IMAGENET_MEAN, IMAGENET_STD)
+    def _prep_train(self, x: np.ndarray,
+                    rng: np.random.Generator) -> np.ndarray:
+        return augment_normalize(x, self.crop, self.crop, rng,
+                                 mean=IMAGENET_MEAN, std=IMAGENET_STD)
+
+    def _prep_val(self, x: np.ndarray) -> np.ndarray:
+        return center_normalize(x, self.crop, self.crop,
+                                mean=IMAGENET_MEAN, std=IMAGENET_STD)
 
     # -- synthetic path ------------------------------------------------------
 
@@ -199,10 +204,10 @@ class ImageNet_data(Dataset):
             idx = rng.integers(0, pool, size=global_batch)
             x, y = self._pool_x[idx], self._pool_y[idx]
             if train:
-                x = random_crop_flip(x, self.crop, self.crop, rng)
+                x = self._prep_train(x, rng)
             else:
-                x = center_crop(x, self.crop, self.crop)
-            yield self._prep(x), y
+                x = self._prep_val(x)
+            yield x, y
 
     # -- file path -----------------------------------------------------------
 
@@ -244,10 +249,10 @@ class ImageNet_data(Dataset):
                 buf_x, buf_y = [x_all[global_batch:]], [y_all[global_batch:]]
                 buffered -= global_batch
                 if aug_rng is not None:
-                    xb = random_crop_flip(xb, self.crop, self.crop, aug_rng)
+                    xb = self._prep_train(xb, aug_rng)
                 else:
-                    xb = center_crop(xb, self.crop, self.crop)
-                yield self._prep(xb), yb
+                    xb = self._prep_val(xb)
+                yield xb, yb
 
     # -- Dataset interface ---------------------------------------------------
 

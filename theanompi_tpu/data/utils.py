@@ -1,15 +1,40 @@
-"""Host-side augmentation: random crop + horizontal mirror.
+"""Host-side augmentation: random crop + horizontal mirror + normalize.
 
 Parity with the reference's on-the-fly crop/flip in its parallel
 loader (``theanompi/models/data/utils.py`` per SURVEY.md §2.9/§3.4 —
-mount empty, no file:line).  Vectorised numpy over the whole batch
-(the reference looped per image in its loader process); kept on host
-so the device step stays static-shaped.
+mount empty, no file:line).  Two implementations with identical
+randomness and results:
+
+* the fused native C++ kernel (theanompi_tpu/native) — one pass per
+  image, used automatically for uint8 input when the lazy g++ build
+  succeeded;
+* vectorised numpy (pad copy + gather + astype + arithmetic), the
+  portable fallback and the oracle the native path is tested against.
+
+Either way the work stays on host so the device step is static-shaped.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from theanompi_tpu import native
+
+
+def _gather_crops(images, ys, xs, flips, crop_h, crop_w, pad):
+    """Pad-gather-flip in numpy (the oracle for the native kernel):
+    reflect-pad, strided fancy-index gather of each crop window, then
+    mirror the flipped subset."""
+    n = images.shape[0]
+    if pad:
+        images = np.pad(
+            images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+        )
+    rows = ys[:, None, None] + np.arange(crop_h)[None, :, None]
+    cols = xs[:, None, None] + np.arange(crop_w)[None, None, :]
+    out = images[np.arange(n)[:, None, None], rows, cols]
+    out[flips] = out[flips, :, ::-1]
+    return out
 
 
 def random_crop_flip(
@@ -25,26 +50,15 @@ def random_crop_flip(
     ``pad`` reflects-pads H/W first (CIFAR-style 4-px padding).  When
     the image already equals the crop size and pad=0, only flips apply.
     """
-    n, h, w, c = images.shape
-    if pad:
-        images = np.pad(
-            images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
-        )
-        h, w = h + 2 * pad, w + 2 * pad
-    if h < crop_h or w < crop_w:
-        raise ValueError(f"images {h}x{w} smaller than crop {crop_h}x{crop_w}")
-
-    ys = rng.integers(0, h - crop_h + 1, size=n)
-    xs = rng.integers(0, w - crop_w + 1, size=n)
-    # gather crops via strided fancy indexing (one pass, no python loop)
-    rows = ys[:, None, None] + np.arange(crop_h)[None, :, None]
-    cols = xs[:, None, None] + np.arange(crop_w)[None, None, :]
-    out = images[np.arange(n)[:, None, None], rows, cols]
-
-    if flip:
-        mask = rng.random(n) < 0.5
-        out[mask] = out[mask, :, ::-1]
-    return np.ascontiguousarray(out)
+    n, h, w, _ = images.shape
+    ph, pw = h + 2 * pad, w + 2 * pad
+    if ph < crop_h or pw < crop_w:
+        raise ValueError(f"images {ph}x{pw} smaller than crop {crop_h}x{crop_w}")
+    ys = rng.integers(0, ph - crop_h + 1, size=n)
+    xs = rng.integers(0, pw - crop_w + 1, size=n)
+    flips = (rng.random(n) < 0.5) if flip else np.zeros(n, bool)
+    return np.ascontiguousarray(
+        _gather_crops(images, ys, xs, flips, crop_h, crop_w, pad))
 
 
 def center_crop(images: np.ndarray, crop_h: int, crop_w: int) -> np.ndarray:
@@ -57,3 +71,78 @@ def normalize(images: np.ndarray, mean, std) -> np.ndarray:
     mean = np.asarray(mean, np.float32).reshape(1, 1, 1, -1)
     std = np.asarray(std, np.float32).reshape(1, 1, 1, -1)
     return (images.astype(np.float32) - mean) / std
+
+
+def _mean_std(c: int, mean, std):
+    m = np.zeros(c, np.float32) if mean is None else np.asarray(mean, np.float32)
+    s = np.ones(c, np.float32) if std is None else np.asarray(std, np.float32)
+    return m, s
+
+
+def _use_native(images: np.ndarray) -> bool:
+    return images.dtype == np.uint8 and native.native_available()
+
+
+def augment_normalize(
+    images: np.ndarray,
+    crop_h: int,
+    crop_w: int,
+    rng: np.random.Generator,
+    *,
+    flip: bool = True,
+    pad: int = 0,
+    mean=None,
+    std=None,
+    divisor: float = 255.0,
+) -> np.ndarray:
+    """Random crop (reflect ``pad``) + mirror-half + normalize, fused.
+
+    Randomness is drawn up front in a fixed order, so native and numpy
+    paths produce IDENTICAL batches for the same ``rng`` state (and the
+    draw order matches the historical ``random_crop_flip``).
+    """
+    n, h, w, c = images.shape
+    ph, pw = h + 2 * pad, w + 2 * pad
+    if ph < crop_h or pw < crop_w:
+        raise ValueError(f"images {ph}x{pw} smaller than crop {crop_h}x{crop_w}")
+    ys = rng.integers(0, ph - crop_h + 1, size=n)
+    xs = rng.integers(0, pw - crop_w + 1, size=n)
+    flips = (rng.random(n) < 0.5) if flip else np.zeros(n, bool)
+    if _use_native(images):
+        m, s = _mean_std(c, mean, std)
+        return native.crop_flip_normalize(images, ys, xs, flips, crop_h,
+                                          crop_w, m, s, divisor=divisor,
+                                          pad=pad)
+    out = _gather_crops(images, ys, xs, flips, crop_h, crop_w, pad)
+    out = out.astype(np.float32) / divisor
+    if mean is not None or std is not None:
+        out = normalize(out, *_mean_std(c, mean, std))
+    return np.ascontiguousarray(out)
+
+
+def center_normalize(
+    images: np.ndarray,
+    crop_h: int,
+    crop_w: int,
+    *,
+    mean=None,
+    std=None,
+    divisor: float = 255.0,
+) -> np.ndarray:
+    """Deterministic center crop + normalize (validation path)."""
+    n, h, w, c = images.shape
+    if h < crop_h or w < crop_w:
+        raise ValueError(f"images {h}x{w} smaller than crop {crop_h}x{crop_w}")
+    y0, x0 = (h - crop_h) // 2, (w - crop_w) // 2
+    if _use_native(images):
+        m, s = _mean_std(c, mean, std)
+        ys = np.full(n, y0, np.int64)
+        xs = np.full(n, x0, np.int64)
+        return native.crop_flip_normalize(images, ys, xs,
+                                          np.zeros(n, np.uint8), crop_h,
+                                          crop_w, m, s, divisor=divisor,
+                                          pad=0)
+    out = center_crop(images, crop_h, crop_w).astype(np.float32) / divisor
+    if mean is not None or std is not None:
+        out = normalize(out, *_mean_std(c, mean, std))
+    return out

@@ -316,8 +316,11 @@ class TpuModel:
         batch = next(self._train_iter)
         recorder.end("wait")  # time blocked on the loader = reference 'wait'
         recorder.start()
-        self.state, metrics = self.train_step(self.state, batch,
-                                              self._next_rng())
+        # the annotation labels this iteration in jax.profiler traces
+        # (utils/profiling.py); free when no trace is active
+        with jax.profiler.StepTraceAnnotation("train", step_num=count):
+            self.state, metrics = self.train_step(self.state, batch,
+                                                  self._next_rng())
         recorder.end("calc")  # async dispatch; device time lands on flush
         self._pending.append((count, metrics))
         # flush window: print_freq when printing, else a fixed window so

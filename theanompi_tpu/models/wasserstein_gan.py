@@ -99,10 +99,11 @@ class WGANState:
 
 class WGANCifar_data(Cifar10_data):
     """CIFAR images scaled to the generator's tanh range [-1, 1]
-    (instead of the classifier mean/std normalization)."""
+    (instead of the classifier mean/std normalization):
+    ((px/255) - 0.5) / 0.5 == px/127.5 - 1."""
 
-    def _prep(self, x: np.ndarray) -> np.ndarray:
-        return x.astype(np.float32) / 127.5 - 1.0
+    mean = (0.5, 0.5, 0.5)
+    std = (0.5, 0.5, 0.5)
 
 
 def clip_params(params: PyTree, c: float) -> PyTree:

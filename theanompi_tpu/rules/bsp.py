@@ -18,18 +18,24 @@ from theanompi_tpu.models.base import TpuModel
 from theanompi_tpu.parallel.mesh import data_mesh
 from theanompi_tpu.rules.base import Rule, resolve_model_class
 from theanompi_tpu.utils.checkpoint import Checkpointer
+from theanompi_tpu.utils.profiling import StepProfiler
 from theanompi_tpu.utils.recorder import Recorder
 
 
 def run_bsp_session(model: TpuModel, sync_type: str = "avg",
                     resume: bool = False, recorder: Recorder | None = None,
                     max_epochs: int | None = None,
-                    checkpoint: bool = True) -> dict:
-    """The BSP epoch loop (callable directly, e.g. from the launcher)."""
+                    checkpoint: bool = True,
+                    profile_dir: str | None = None) -> dict:
+    """The BSP epoch loop (callable directly, e.g. from the launcher).
+
+    ``profile_dir`` (or env ``THEANOMPI_TPU_PROFILE``) captures a
+    jax.profiler trace of the first steps — utils/profiling.py."""
     cfg = model.config
     recorder = recorder or Recorder(rank=0, size=model.n_workers,
                                     print_freq=cfg.print_freq,
                                     save_dir=cfg.snapshot_dir)
+    profiler = StepProfiler(profile_dir)
     model.compile_iter_fns(sync_type)
 
     ckpt = None
@@ -50,19 +56,24 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
     n_epochs = model.n_epochs if max_epochs is None else min(
         model.n_epochs, start_epoch + max_epochs)
     last_val: dict = {}
-    for epoch in range(start_epoch, n_epochs):
-        n_iters = model.begin_epoch(epoch)
-        for it in range(n_iters):
-            model.train_iter(it, recorder)
-        model._flush_metrics(recorder)
-        recorder.start()
-        last_val = model.val_epoch(recorder)
-        recorder.end("calc")
-        model.adjust_hyperp(epoch + 1)
-        if ckpt is not None:
-            ckpt.save(epoch, {"state": model.state, "epoch": epoch})
-        recorder.epoch_summary(epoch, last_val.get("loss"),
-                               last_val.get("error"))
+    profiler.maybe_start()
+    try:
+        for epoch in range(start_epoch, n_epochs):
+            n_iters = model.begin_epoch(epoch)
+            for it in range(n_iters):
+                model.train_iter(it, recorder)
+                profiler.step()  # trace spans epochs until n_steps hit
+            model._flush_metrics(recorder)
+            recorder.start()
+            last_val = model.val_epoch(recorder)
+            recorder.end("calc")
+            model.adjust_hyperp(epoch + 1)
+            if ckpt is not None:
+                ckpt.save(epoch, {"state": model.state, "epoch": epoch})
+            recorder.epoch_summary(epoch, last_val.get("loss"),
+                                   last_val.get("error"))
+    finally:
+        profiler.stop()
     model.cleanup()
     if ckpt is not None:
         ckpt.close()

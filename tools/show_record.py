@@ -1,0 +1,67 @@
+"""Print a training run's recorder curves (the reference shipped a
+show_record plotting script over the Recorder's saved state —
+SURVEY.md §2.10).
+
+Reads the JSONL epoch records written by utils/recorder.py and prints
+a per-epoch table plus ASCII sparklines for loss / val error /
+images-per-sec.
+
+Usage: python tools/show_record.py <snapshot_dir> [rank]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+BARS = "▁▂▃▄▅▆▇█"
+
+
+def spark(values):
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    rng = (hi - lo) or 1.0
+    return "".join(
+        " " if v is None else BARS[int((v - lo) / rng * (len(BARS) - 1))]
+        for v in values
+    )
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    save_dir = sys.argv[1]
+    rank = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    path = os.path.join(save_dir, f"record_rank{rank}.jsonl")
+    if not os.path.exists(path):
+        print(f"no record at {path}")
+        return 1
+    with open(path) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    if not recs:
+        print("empty record")
+        return 1
+
+    print(f"{'epoch':>5} {'img/s':>9} {'train_loss':>11} {'val_loss':>9} "
+          f"{'val_err':>8} {'calc':>7} {'comm':>7} {'wait':>7} {'load':>7}")
+    for r in recs:
+        t = r.get("time", {})
+        fmt = lambda v, n=4: "-" if v is None else f"{v:.{n}f}"  # noqa: E731
+        print(f"{r['epoch']:>5} {r['images_per_sec']:>9} "
+              f"{fmt(r['train_loss']):>11} {fmt(r['val_loss']):>9} "
+              f"{fmt(r['val_error']):>8} "
+              + " ".join(f"{t.get(k, 0):>7.1f}"
+                         for k in ("calc", "comm", "wait", "load")))
+    print()
+    print(f"train_loss  {spark([r['train_loss'] for r in recs])}")
+    print(f"val_error   {spark([r['val_error'] for r in recs])}")
+    print(f"images/sec  {spark([r['images_per_sec'] for r in recs])}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
