@@ -52,9 +52,11 @@ class SeqLM_data(Dataset):
                       rank: int = 0, size: int = 1) -> Iterator[Batch]:
         n = self.n_train_batches_for(epoch, global_batch, rank, size)
         for i in range(n):
-            # batch content is a pure function of (seed, epoch, i, rank)
-            yield self._gen(global_batch,
-                            self.seed + hash((epoch, i, rank)) % (2**31))
+            # batch content is a pure function of (seed, epoch, i, rank);
+            # SeedSequence gives a portable, collision-resistant derivation
+            # (builtin hash() is a CPython implementation detail)
+            ss = np.random.SeedSequence([self.seed, epoch, i, rank])
+            yield self._gen(global_batch, int(ss.generate_state(1)[0]))
 
     def val_batches(self, global_batch: int,
                     rank: int = 0, size: int = 1) -> Iterator[Batch]:

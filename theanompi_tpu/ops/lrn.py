@@ -42,6 +42,37 @@ def window_sum(v: jax.Array, n: int, adjoint: bool = False) -> jax.Array:
     return win
 
 
+_PALLAS_OK: bool | None = None  # lazily probed once per process
+
+
+def _pallas_available() -> bool:
+    """One-time probe: compile+run the Pallas kernel on a tiny input.
+
+    'auto' was validated on v5e only; other TPU generations could hit a
+    Mosaic lowering regression that would otherwise surface mid-train.
+    A failed probe falls back to the composed-XLA impl (which lowers
+    everywhere) and warns once.  Explicit ``impl='pallas'`` skips the
+    probe so real errors stay loud.
+    """
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            from theanompi_tpu.ops.lrn_pallas import lrn_pallas
+
+            x = jnp.ones((1, 8, 8, 16), jnp.float32)
+            jax.block_until_ready(lrn_pallas(x, 5, 2.0, 1e-4, 0.75, True))
+            _PALLAS_OK = True
+        except Exception as e:  # lowering/compile failure on this backend
+            import warnings
+
+            warnings.warn(
+                f"Pallas LRN unavailable on this backend ({e!r}); "
+                "falling back to the composed-XLA impl. Set "
+                "THEANOMPI_TPU_LRN_IMPL=pallas to force (and see the error).")
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
 def lrn(
     x: jax.Array,
     n: int = 5,
@@ -66,7 +97,8 @@ def lrn(
         raise ValueError(f"lrn expects NHWC, got shape {x.shape}")
     impl = impl or os.environ.get("THEANOMPI_TPU_LRN_IMPL", "auto")
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        impl = ("pallas" if jax.default_backend() == "tpu"
+                and _pallas_available() else "xla")
     if impl == "pallas":
         from theanompi_tpu.ops.lrn_pallas import lrn_pallas
 

@@ -74,9 +74,9 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
                                    last_val.get("error"))
     finally:
         profiler.stop()
-    model.cleanup()
-    if ckpt is not None:
-        ckpt.close()
+        model.cleanup()  # also on failure: stops the prefetcher thread
+        if ckpt is not None:
+            ckpt.close()
     return {"val": last_val, "epochs_run": n_epochs - start_epoch,
             "records": recorder.epoch_records}
 
