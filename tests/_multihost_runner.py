@@ -1,0 +1,95 @@
+"""Subprocess entry for the multi-host tests.
+
+Each OS process gets ``--devices-per-proc`` virtual CPU devices; with
+``--nprocs > 1`` the processes join one ``jax.distributed`` job and the
+BSP session forms a single global mesh over all of them — the TPU-native
+equivalent of the reference's ``tmlauncher``-over-mpirun deployment
+(SURVEY.md §2.1/§3.1; mount empty, no file:line).
+
+Emits JSON to ``--out``: per-step train losses (in order), final val
+metrics, and mesh facts the parent asserts on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--proc-id", type=int, default=0)
+    ap.add_argument("--nprocs", type=int, default=1)
+    ap.add_argument("--port", type=int, default=45701)
+    ap.add_argument("--devices-per-proc", type=int, default=4)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--snapshot-dir", default="/tmp/tm_multihost_snap")
+    ap.add_argument("--checkpoint", action="store_true")
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.devices_per_proc}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if args.nprocs > 1:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{args.port}",
+            num_processes=args.nprocs,
+            process_id=args.proc_id,
+        )
+
+    from theanompi_tpu.data.cifar10 import Cifar10_data
+    from theanompi_tpu.models.base import ModelConfig
+    from theanompi_tpu.models.cifar10 import Cifar10_model
+    from theanompi_tpu.parallel.mesh import data_mesh, is_multiprocess
+    from theanompi_tpu.rules.bsp import run_bsp_session
+    from theanompi_tpu.utils.recorder import Recorder
+
+    class SmallCifar(Cifar10_model):
+        def build_data(self):
+            return Cifar10_data(synthetic_n=1024, seed=self.config.seed)
+
+    class CaptureRecorder(Recorder):
+        """Keeps every per-step loss across epochs (train_losses resets
+        at each epoch summary)."""
+
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.all_losses: list[float] = []
+
+        def train_metrics(self, loss, error, n_images):
+            self.all_losses.append(float(loss))
+            super().train_metrics(loss, error, n_images)
+
+    cfg = ModelConfig(batch_size=8, n_epochs=100, learning_rate=0.05,
+                      print_freq=0, snapshot_dir=args.snapshot_dir)
+    devs = jax.devices()
+    mesh = data_mesh(len(devs), devs)
+    model = SmallCifar(config=cfg, mesh=mesh, verbose=False)
+    rec = CaptureRecorder(rank=model.host_rank, size=model.n_workers,
+                          print_freq=0)
+    result = run_bsp_session(model, resume=args.resume, recorder=rec,
+                             max_epochs=args.epochs,
+                             checkpoint=args.checkpoint)
+    with open(args.out, "w") as f:
+        json.dump({
+            "proc_id": args.proc_id,
+            "n_global_devices": len(devs),
+            "n_local_devices": len(jax.local_devices()),
+            "multiprocess": is_multiprocess(mesh),
+            "losses": rec.all_losses,
+            "val": {k: float(v) for k, v in result["val"].items()},
+            "epochs_run": result["epochs_run"],
+        }, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Multi-host BSP: 2 controller processes x 4 virtual CPU devices form
+ONE 8-device global mesh, and the loss curve matches the single-process
+8-device run step for step.
+
+This is the acceptance test for the reference's multi-node deployment
+surface (``tmlauncher`` over mpirun — SURVEY.md §2.1/§3.1/§7-6; mount
+empty, no file:line): psum crosses the process boundary (gloo on CPU,
+DCN on real TPU pods), each host feeds only its slice of the global
+batch (``jax.make_array_from_process_local_data``), and rank-0 gating
+covers printing and the JSONL curve.
+
+Runs real OS processes — the same discipline the reference needed a
+cluster for, executable on one box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+RUNNER = os.path.join(os.path.dirname(__file__), "_multihost_runner.py")
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    # the runner sets its own device-count flag; drop the conftest's
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_procs(nprocs: int, port: int, outdir: str, devices_per_proc: int,
+               epochs: int = 2, extra: list[str] | None = None) -> list[dict]:
+    procs = []
+    outs = []
+    for pid in range(nprocs):
+        out = os.path.join(outdir, f"out_{nprocs}p_{pid}.json")
+        outs.append(out)
+        cmd = [sys.executable, RUNNER, "--proc-id", str(pid),
+               "--nprocs", str(nprocs), "--port", str(port),
+               "--devices-per-proc", str(devices_per_proc),
+               "--epochs", str(epochs), "--out", out,
+               "--snapshot-dir", os.path.join(outdir, "snap")]
+        procs.append(subprocess.Popen(cmd + (extra or []), env=_clean_env(),
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    results = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=600)
+        assert p.returncode == 0, (
+            f"runner failed (rc={p.returncode}):\n{stdout.decode()[-4000:]}")
+    for out in outs:
+        with open(out) as f:
+            results.append(json.load(f))
+    return results
+
+
+@pytest.fixture(scope="module")
+def workdir():
+    d = tempfile.mkdtemp(prefix="tm_multihost_")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.mark.slow
+def test_two_process_bsp_matches_single_process(workdir):
+    two = _run_procs(2, port=45711, outdir=workdir, devices_per_proc=4)
+    one = _run_procs(1, port=45712, outdir=workdir, devices_per_proc=8)
+
+    # both processes saw one global 8-device mesh, 4 local each
+    for r in two:
+        assert r["n_global_devices"] == 8
+        assert r["n_local_devices"] == 4
+        assert r["multiprocess"] is True
+    assert one[0]["n_global_devices"] == 8
+    assert one[0]["multiprocess"] is False
+
+    # every process computes the same (replicated) loss sequence
+    l0, l1 = np.array(two[0]["losses"]), np.array(two[1]["losses"])
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+
+    # ... and it matches the single-process global-mesh run step for
+    # step (same data order, same math; gloo vs single-process psum
+    # reduction order can differ in the last ulp)
+    single = np.array(one[0]["losses"])
+    assert len(single) == len(l0) > 0
+    np.testing.assert_allclose(l0, single, rtol=1e-4, atol=1e-6)
+
+    # val path (host-sliced val batches + pmean) agrees too
+    assert two[0]["val"]["error"] == pytest.approx(
+        one[0]["val"]["error"], rel=1e-3, abs=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_resume(workdir):
+    d = os.path.join(workdir, "resume")
+    os.makedirs(d, exist_ok=True)
+    # continuous 2-epoch reference
+    cont = _run_procs(2, port=45713, outdir=d, devices_per_proc=4, epochs=2)
+    # 1 epoch with checkpoint, then resume for 1 more
+    d2 = os.path.join(workdir, "resume_split")
+    os.makedirs(d2, exist_ok=True)
+    _run_procs(2, port=45714, outdir=d2, devices_per_proc=4, epochs=1,
+               extra=["--checkpoint"])
+    resumed = _run_procs(2, port=45715, outdir=d2, devices_per_proc=4,
+                         epochs=1, extra=["--checkpoint", "--resume"])
+
+    assert resumed[0]["epochs_run"] == 1
+    n = len(cont[0]["losses"]) // 2
+    np.testing.assert_allclose(np.array(resumed[0]["losses"]),
+                               np.array(cont[0]["losses"])[n:],
+                               rtol=1e-4, atol=1e-6)

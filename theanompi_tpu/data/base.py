@@ -51,6 +51,43 @@ class Dataset(abc.ABC):
     ) -> Iterator[Batch]:
         """Yield validation batches in fixed order, no augmentation."""
 
+    # -- multi-host (one controller process per host) -------------------
+
+    @staticmethod
+    def _block_slice(batch: Batch, host_rank: int, host_count: int) -> Batch:
+        x, y = batch
+        if len(x) % host_count != 0:
+            raise ValueError(
+                f"global batch {len(x)} not divisible by {host_count} hosts")
+        chunk = len(x) // host_count
+        sl = slice(host_rank * chunk, (host_rank + 1) * chunk)
+        return x[sl], y[sl]
+
+    def host_train_batches(self, epoch: int, global_batch: int,
+                           host_rank: int, host_count: int) -> Iterator[Batch]:
+        """This host's contiguous block of each *global* train batch.
+
+        Multi-host BSP: ``jax.devices()`` orders devices by process, so
+        host p's addressable shards cover rows
+        ``[p*B/P, (p+1)*B/P)`` of every global batch;
+        ``shard_batch`` reassembles the global array from these slices
+        (``jax.make_array_from_process_local_data``).  Shuffle and
+        augmentation order are pure functions of ``epoch`` (class
+        docstring), so every host derives the identical global batch and
+        the multi-host run is bit-equivalent to the single-process run.
+
+        Default: build the global batch and slice — correct everywhere;
+        datasets whose storage is row-addressable should override to
+        read only their rows.
+        """
+        for batch in self.train_batches(epoch, global_batch):
+            yield self._block_slice(batch, host_rank, host_count)
+
+    def host_val_batches(self, global_batch: int, host_rank: int,
+                         host_count: int) -> Iterator[Batch]:
+        for batch in self.val_batches(global_batch):
+            yield self._block_slice(batch, host_rank, host_count)
+
     def n_train_batches(self, global_batch: int) -> int:
         from theanompi_tpu.utils.helper_funcs import divide_batches
 

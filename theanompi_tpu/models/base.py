@@ -50,7 +50,14 @@ from theanompi_tpu.parallel.bsp import (
     make_bsp_train_step,
 )
 from theanompi_tpu.parallel.exchanger import BSP_Exchanger
-from theanompi_tpu.parallel.mesh import data_axis_size, data_mesh, replicate
+from theanompi_tpu.parallel.mesh import (
+    data_axis_size,
+    data_mesh,
+    host_count,
+    host_rank,
+    is_multiprocess,
+    replicate,
+)
 from theanompi_tpu.utils.helper_funcs import (
     load_params_npz,
     save_params_npz,
@@ -137,6 +144,15 @@ class TpuModel:
         # shards the global batch instead)
         self.shard_rank = shard_rank
         self.shard_size = shard_size
+        # multi-host: this controller feeds only its host's slice of
+        # every global batch (data/base.py host_train_batches)
+        self.multiprocess = is_multiprocess(self.mesh)
+        self.host_rank = host_rank() if self.multiprocess else 0
+        self.host_count = host_count() if self.multiprocess else 1
+        if self.multiprocess and shard_size > 1:
+            raise ValueError(
+                "per-worker data sharding (shard_size>1, async rules) and a "
+                "multi-host mesh cannot be combined in one model instance")
         self.batch_size = self.config.batch_size
         self.global_batch = self.batch_size * self.n_workers
         self.n_epochs = self.config.n_epochs
@@ -297,13 +313,19 @@ class TpuModel:
         """Stage the epoch's prefetched train iterator; returns n_iters."""
         self.cleanup_iter()
         self.current_epoch = epoch
-        host_iter = self.data.train_batches(epoch, self.global_batch,
-                                            self.shard_rank, self.shard_size)
+        if self.multiprocess:
+            host_iter = self.data.host_train_batches(
+                epoch, self.global_batch, self.host_rank, self.host_count)
+            n_iters = self.data.n_train_batches_for(epoch, self.global_batch)
+        else:
+            host_iter = self.data.train_batches(
+                epoch, self.global_batch, self.shard_rank, self.shard_size)
+            n_iters = self.data.n_train_batches_for(
+                epoch, self.global_batch, self.shard_rank, self.shard_size)
         self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh,
                                                   spec=self.batch_partition)
         self._train_iter = iter(self._train_prefetcher)
-        return self.data.n_train_batches_for(epoch, self.global_batch,
-                                             self.shard_rank, self.shard_size)
+        return n_iters
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -355,7 +377,11 @@ class TpuModel:
         """Full validation pass; returns averaged metrics."""
         sums: dict[str, float] = {}
         n = 0
-        host_iter = self.data.val_batches(self.global_batch)
+        if self.multiprocess:
+            host_iter = self.data.host_val_batches(
+                self.global_batch, self.host_rank, self.host_count)
+        else:
+            host_iter = self.data.val_batches(self.global_batch)
         with DevicePrefetcher(host_iter, self.mesh,
                               spec=self.batch_partition) as pf:
             for batch in pf:

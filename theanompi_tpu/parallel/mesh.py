@@ -102,6 +102,21 @@ def data_mesh(n: int | None = None,
     return make_training_mesh(MeshSpec(data=len(devices)), devices)
 
 
+def is_multiprocess(mesh: Mesh) -> bool:
+    """True iff ``mesh`` spans devices of more than one controller
+    process (multi-host launch under ``jax.distributed``)."""
+    procs = {d.process_index for d in mesh.devices.flat}
+    return len(procs) > 1
+
+
+def host_rank() -> int:
+    return jax.process_index()
+
+
+def host_count() -> int:
+    return jax.process_count()
+
+
 def batch_spec(mesh: Mesh) -> P:
     """PartitionSpec sharding the leading (batch) dim over data(+seq is
     left to attention ops; batch rides ``data`` only)."""
@@ -136,13 +151,29 @@ def shard_batch(batch, mesh: Mesh, spec: P | None = None):
     The moral equivalent of the reference's per-rank H2D staging of its
     data shard (SURVEY.md §3.4) — here a single ``device_put`` with a
     NamedSharding splits the global batch across chips.
+
+    Multi-host: when the mesh spans processes, ``batch`` must be this
+    host's *slice* of the global batch (``Dataset.host_train_batches``)
+    and the global array is assembled with
+    ``jax.make_array_from_process_local_data`` — each host feeds only
+    its addressable shards; no host ever addresses remote devices.
     """
     sh = NamedSharding(mesh, spec if spec is not None else batch_spec(mesh))
+    if is_multiprocess(mesh):
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sh, np.asarray(x)), batch)
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
 
 
 def replicate(tree, mesh: Mesh):
     sh = replicated(mesh)
+    if is_multiprocess(mesh):
+        # every host holds the full value; each contributes its local
+        # replicas (device_put cannot address remote devices)
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sh, np.asarray(x)), tree)
     return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
 
 

@@ -49,12 +49,31 @@ def resolve_model_class(modelfile: str, modelclass: str) -> type:
         ) from e
 
 
-def resolve_devices(devices: int | Sequence | None) -> list:
+def resolve_devices(devices: int | Sequence | None,
+                    global_mesh: bool = False) -> list:
     """Accept None (all), an int count, device indices, or jax Devices.
 
-    Uses *local* devices: a rule session runs in one process and must
-    only place state on devices this process addresses (under
-    multi-host launch each host process drives its own chips)."""
+    Single-process: local devices.  Multi-host (``jax.distributed``
+    initialized, ``process_count() > 1``) with ``global_mesh=True``
+    (BSP — one SPMD program): the GLOBAL device list, so every host
+    traces the same program over one mesh and ``psum`` crosses DCN;
+    device subsetting is not supported there (each host participates
+    with all its chips).  Rules that place per-worker state
+    (``global_mesh=False``, the async rules) must only ever see devices
+    this process addresses.
+    """
+    if jax.process_count() > 1:
+        if not global_mesh:
+            raise NotImplementedError(
+                "async rules under multi-host launch need the DCN server "
+                "transport (parallel/service); run them per-host, or use "
+                "BSP for multi-host")
+        if devices is not None:
+            raise ValueError(
+                "device selection is not supported under multi-host launch; "
+                "all devices of all hosts form the mesh (got "
+                f"devices={devices!r})")
+        return list(jax.devices())
     all_devs = jax.local_devices()
     if devices is None:
         return list(all_devs)
@@ -82,6 +101,10 @@ class Rule:
     """Base: owns session thread + error propagation."""
 
     name = "rule"
+    #: True for rules that run one SPMD program over every device of
+    #: every host (BSP); False for rules that place per-worker state on
+    #: individual local devices (the async rules).
+    uses_global_mesh = False
 
     def __init__(self):
         self._thread: threading.Thread | None = None
@@ -94,7 +117,7 @@ class Rule:
              config: ModelConfig | None = None,
              resume: bool = False, sync_type: str = "avg",
              **kwargs) -> "Rule":
-        devs = resolve_devices(devices)
+        devs = resolve_devices(devices, global_mesh=self.uses_global_mesh)
         self._start(devs, modelfile, modelclass, config, resume, sync_type,
                     **kwargs)
         return self

@@ -32,9 +32,12 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
     ``profile_dir`` (or env ``THEANOMPI_TPU_PROFILE``) captures a
     jax.profiler trace of the first steps — utils/profiling.py."""
     cfg = model.config
-    recorder = recorder or Recorder(rank=0, size=model.n_workers,
-                                    print_freq=cfg.print_freq,
-                                    save_dir=cfg.snapshot_dir)
+    # multi-host: rank = host index, so only host 0 prints / writes the
+    # JSONL curve (the reference's rank-0 gating, SURVEY.md §3.5)
+    host = model.host_rank
+    recorder = recorder or Recorder(
+        rank=host, size=model.n_workers, print_freq=cfg.print_freq,
+        save_dir=cfg.snapshot_dir if host == 0 else None)
     profiler = StepProfiler(profile_dir)
     model.compile_iter_fns(sync_type)
 
@@ -85,6 +88,7 @@ class BSP(Rule):
     """Synchronous BSP data-parallel rule (reference rule #1)."""
 
     name = "BSP"
+    uses_global_mesh = True
 
     def _session(self, devs, modelfile, modelclass, config, resume,
                  sync_type, max_epochs=None, checkpoint=True, **kwargs):
