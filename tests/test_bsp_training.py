@@ -18,6 +18,7 @@ def small_cfg(tmp_path, **kw):
     return ModelConfig(**base)
 
 
+@pytest.mark.slow
 def test_bsp_learns(mesh8, tmp_path):
     cfg = small_cfg(tmp_path, n_epochs=3)
     model = Cifar10_model(config=cfg, mesh=mesh8)
@@ -29,6 +30,7 @@ def test_bsp_learns(mesh8, tmp_path):
     assert res["records"][-1]["train_loss"] < res["records"][0]["train_loss"]
 
 
+@pytest.mark.slow
 def test_bsp_checkpoint_resume(mesh8, tmp_path):
     cfg = small_cfg(tmp_path, n_epochs=2)
     model = Cifar10_model(config=cfg, mesh=mesh8)
@@ -68,6 +70,7 @@ def test_bsp_rule_propagates_errors():
         rule.wait()
 
 
+@pytest.mark.slow
 def test_sum_mode_with_scaled_lr_matches_avg(mesh8, tmp_path):
     """sync_type 'cdd' (sum) with lr/N ~ 'avg' with lr (exchanger parity)."""
     cfg_avg = small_cfg(tmp_path, n_epochs=1, seed=7)

@@ -138,6 +138,7 @@ class TestResNet50:
                           print_freq=4, track_top5=True)
         return TinyRN(config=cfg, mesh=mesh8)
 
+    @pytest.mark.slow
     def test_train_and_val(self, mesh8):
         from theanompi_tpu.utils.recorder import Recorder
 
@@ -174,6 +175,7 @@ class TestResNet50:
         m.cleanup()
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     # conftest already pinned cpu + 8 virtual devices, so the dryrun's
     # own forcing is a no-op and 8 devices are available.

@@ -51,6 +51,7 @@ class TestAlexNet:
         # conv2 has 2 groups: kernel in-channels = 96/2 = 48
         assert any(s == (5, 5, 48, 256) for s in shapes), shapes
 
+    @pytest.mark.slow
     def test_train_and_val(self, mesh8):
         run_short_training(self.make(mesh8))
 
@@ -73,6 +74,7 @@ class TestVGG16:
                           print_freq=100)
         return TinyVGG(config=cfg, mesh=mesh8)
 
+    @pytest.mark.slow
     def test_train_and_val(self, mesh8):
         run_short_training(self.make(mesh8))
 
@@ -95,6 +97,7 @@ class TestGoogLeNet:
                           print_freq=100)
         return TinyGoogLeNet(config=cfg, mesh=mesh8)
 
+    @pytest.mark.slow
     def test_aux_heads_exist_and_train(self, mesh8):
         m = self.make(mesh8)
         assert "aux1" in m.state.params and "aux2" in m.state.params

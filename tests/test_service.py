@@ -1,0 +1,170 @@
+"""DCN transport for the async rules (parallel/service.py).
+
+The reference's async parameter traffic rode MPI p2p between ranks on
+different machines (SURVEY.md §2.5/§3.3/§5.8); here the equivalent is a
+TCP parameter service.  These tests prove the wire path end to end:
+the protocol round-trips pytrees, the remote stores keep their
+arithmetic, and — the acceptance bar (VERDICT round 1, next-round #4)
+— an EASGD session whose server lives in a SEPARATE OS PROCESS
+converges on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel.service import (
+    RemoteASGD,
+    RemoteEASGD,
+    RemoteGossipHub,
+    ServiceClient,
+    serve,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def local_service():
+    """serve() on a background thread (same process, real sockets)."""
+    port = _free_port()
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve,
+                         args=("127.0.0.1", port, ready, stop), daemon=True)
+    t.start()
+    assert ready.wait(10)
+    yield f"127.0.0.1:{port}"
+    stop.set()
+    try:
+        ServiceClient(f"127.0.0.1:{port}").call("shutdown")
+    except Exception:
+        pass
+    t.join(timeout=5)
+
+
+def test_remote_easgd_matches_closed_form(local_service):
+    params = {"w": np.ones((4, 3), np.float32), "b": np.zeros(3, np.float32)}
+    alpha = 0.5
+    srv = RemoteEASGD(local_service, params, alpha=alpha)
+
+    worker = {"w": np.full((4, 3), 3.0, np.float32),
+              "b": np.full(3, 2.0, np.float32)}
+    new_w = srv.exchange(worker)
+    # worker <- worker - a(worker - center): 3 - .5(3-1) = 2 ; 2 - .5*2 = 1
+    np.testing.assert_allclose(new_w["w"], 2.0)
+    np.testing.assert_allclose(new_w["b"], 1.0)
+    center = srv.get_center()
+    # center <- center + a(worker - center): 1 + .5(3-1) = 2 ; 0 + 1 = 1
+    np.testing.assert_allclose(center["w"], 2.0)
+    np.testing.assert_allclose(center["b"], 1.0)
+    assert srv.n_exchanges == 1
+    srv.close()
+
+
+def test_remote_asgd_applies_sgd(local_service):
+    params = {"w": np.zeros(5, np.float32)}
+    srv = RemoteASGD(local_service, params,
+                     {"learning_rate": 0.1, "momentum": 0.0,
+                      "nesterov": False, "weight_decay": 0.0})
+    fresh = srv.push_pull({"w": np.ones(5, np.float32)})
+    np.testing.assert_allclose(fresh["w"], -0.1, rtol=1e-6)
+    srv.set_lr(0.5)
+    fresh = srv.push_pull({"w": np.ones(5, np.float32)})
+    np.testing.assert_allclose(fresh["w"], -0.6, rtol=1e-6)
+    assert srv.n_updates == 2
+    srv.close()
+
+
+def test_remote_gossip_hub_roundtrip(local_service):
+    hub_a = RemoteGossipHub(local_service, n_workers=4, rank_offset=0)
+    hub_b = RemoteGossipHub(local_service, n_workers=4, rank_offset=2)
+    # worker 1 (host a) pushes to global rank 3 (= host b local rank 1)
+    assert hub_a.push(3, {"w": np.ones(2, np.float32)}, 0.125)
+    got = hub_b.drain(1)
+    assert len(got) == 1
+    np.testing.assert_allclose(got[0][0]["w"], 1.0)
+    assert got[0][1] == 0.125
+    assert hub_b.drain(1) == []  # drained
+    hub_b.deactivate(1)
+    assert not hub_a.push(3, {"w": np.ones(2, np.float32)}, 0.125)
+    hub_a.close()
+    hub_b.close()
+
+
+def test_bad_authkey_rejected(local_service):
+    old = os.environ.get("THEANOMPI_TPU_SERVICE_KEY")
+    os.environ["THEANOMPI_TPU_SERVICE_KEY"] = "wrong-key"
+    try:
+        with pytest.raises(Exception):
+            ServiceClient(local_service).call("ping")
+    finally:
+        if old is None:
+            os.environ.pop("THEANOMPI_TPU_SERVICE_KEY")
+        else:
+            os.environ["THEANOMPI_TPU_SERVICE_KEY"] = old
+    # service survives the failed handshake
+    c = ServiceClient(local_service)
+    assert c.call("ping") == "pong"
+    c.close()
+
+
+@pytest.mark.slow
+def test_easgd_with_server_in_separate_process(tmp_path):
+    """EASGD converges with its center-param server in another OS
+    process — the reference's server-as-own-rank topology over DCN."""
+    from theanompi_tpu import EASGD
+    from theanompi_tpu.models.base import ModelConfig
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "theanompi_tpu.parallel.service",
+         "--host", "127.0.0.1", "--port", str(port), "--platform", "cpu"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                c = ServiceClient(f"127.0.0.1:{port}")
+                assert c.call("ping") == "pong"
+                c.close()
+                break
+            except (ConnectionRefusedError, OSError):
+                assert time.monotonic() < deadline, "service never came up"
+                assert proc.poll() is None, (
+                    f"service died:\n{proc.stdout.read().decode()[-2000:]}")
+                time.sleep(0.3)
+
+        rule = EASGD()
+        rule.init(devices=4, modelfile="theanompi_tpu.models.cifar10",
+                  modelclass="Cifar10_model",
+                  config=ModelConfig(batch_size=8, n_epochs=2,
+                                     learning_rate=0.01,
+                                     snapshot_dir=str(tmp_path),
+                                     print_freq=0),
+                  tau=5, alpha=0.5, checkpoint=False,
+                  server_addr=f"127.0.0.1:{port}")
+        res = rule.wait()
+        assert res["n_exchanges"] > 0
+        assert res["val"]["error"] < 0.85  # learned something
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in jax.tree.leaves(res["center"]))
+    finally:
+        proc.kill()
+        proc.wait()

@@ -73,6 +73,7 @@ class TestTransformerSP:
         for a, b in zip(jax.tree.leaves(p_sp), jax.tree.leaves(p_dp)):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_zoo_entry_and_session(self, dp_sp_mesh, tmp_path):
         from theanompi_tpu.rules.bsp import run_bsp_session
 

@@ -70,6 +70,7 @@ class TestWGAN:
         for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before)):
             np.testing.assert_allclose(a, b, rtol=1e-6)
 
+    @pytest.mark.slow
     def test_bsp_session_drives_wgan(self, mesh8, tmp_path):
         from theanompi_tpu.models.wasserstein_gan import (
             Wasserstein_GAN,

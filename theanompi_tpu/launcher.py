@@ -56,6 +56,19 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="EASGD elastic coefficient")
     p.add_argument("--p-push", type=float, default=0.1,
                    help="GOSGD per-iteration push probability")
+    p.add_argument("--server-addr", default=None,
+                   help="host:port of a tmserver parameter service — runs "
+                        "the async rule's server over DCN instead of "
+                        "in-process (parallel/service.py)")
+    p.add_argument("--n-total-workers", type=int, default=None,
+                   help="GOSGD: global worker count when several hosts "
+                        "share one --server-addr hub")
+    p.add_argument("--rank-offset", type=int, default=0,
+                   help="GOSGD: this host's first global worker rank")
+    p.add_argument("--session-id", default=None,
+                   help="shared id scoping the --server-addr service "
+                        "store; hosts of ONE training session must pass "
+                        "the same id (default: a fresh uuid per session)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
@@ -117,7 +130,13 @@ def _run(args, multihost: bool) -> int:
     if args.rule == "EASGD":
         kwargs.update(tau=args.tau, alpha=args.alpha)
     elif args.rule == "GOSGD":
-        kwargs.update(p_push=args.p_push)
+        kwargs.update(p_push=args.p_push,
+                      n_total_workers=args.n_total_workers,
+                      rank_offset=args.rank_offset)
+    if args.rule != "BSP" and args.server_addr:
+        kwargs.update(server_addr=args.server_addr)
+        if args.session_id:
+            kwargs.update(session_id=args.session_id)
     rule.init(**kwargs)
     result = rule.wait()
     val = result.get("val", {})

@@ -59,6 +59,7 @@ from theanompi_tpu.parallel.mesh import (
     replicate,
 )
 from theanompi_tpu.utils.helper_funcs import (
+    build_sgd_optimizer,
     load_params_npz,
     save_params_npz,
     scale_lr,
@@ -200,16 +201,16 @@ class TpuModel:
 
     def _build_optimizer(self, lr: float) -> optax.GradientTransformation:
         cfg = self.config
+        return build_sgd_optimizer(lr, momentum=cfg.momentum,
+                                   nesterov=cfg.nesterov,
+                                   weight_decay=cfg.weight_decay)
 
-        def make(learning_rate):
-            parts = []
-            if cfg.weight_decay:
-                parts.append(optax.add_decayed_weights(cfg.weight_decay))
-            parts.append(optax.sgd(learning_rate, momentum=cfg.momentum or None,
-                                   nesterov=cfg.nesterov))
-            return optax.chain(*parts)
-
-        return optax.inject_hyperparams(make)(learning_rate=lr)
+    def optimizer_hyperparams(self) -> dict:
+        """The plain-value description of this model's optimizer — what
+        a remote ASGD service needs to rebuild it (parallel/service.py)."""
+        cfg = self.config
+        return {"learning_rate": self._base_lr, "momentum": cfg.momentum,
+                "nesterov": cfg.nesterov, "weight_decay": cfg.weight_decay}
 
     def loss_fn(self, params, model_state, batch, rng):
         """Default: softmax CE + top-1 error.  Override for GANs etc."""

@@ -129,6 +129,16 @@ class ASGDServer:
         with self._lock:
             return self._center
 
+    def get_opt_state(self) -> PyTree:
+        with self._lock:
+            return self._opt_state
+
+    def set_opt_state(self, opt_state: PyTree) -> None:
+        """Install a restored optimizer state (ASGD resume — the
+        server's momentum/hyperparams ARE the training state)."""
+        with self._lock:
+            self._opt_state = opt_state
+
 
 class GossipHub:
     """Rendezvous for GOSGD's point-to-point pushes (the TPU stand-in

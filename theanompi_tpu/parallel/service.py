@@ -1,0 +1,338 @@
+"""DCN transport for the async rules — a parameter service over TCP.
+
+The reference's EASGD/ASGD servers were dedicated MPI ranks and GOSGD
+used point-to-point MPI sends; all of that rode the cluster fabric
+(SURVEY.md §2.3/§3.3/§5.8 — mount empty, no file:line).  The TPU-native
+split keeps ICI for what XLA schedules (BSP collectives) and gives the
+async rules what MPI p2p gave the reference: a host-level transport
+that crosses machines.
+
+Design: ONE rule-agnostic service process hosts the same stores the
+in-process path uses (``parallel/server.py`` — EASGDServer, ASGDServer,
+GossipHub); stores are created lazily by the first ``*_init`` request,
+so the service needs no model code or rule flag at launch.  Clients
+mirror the stores' duck-type APIs, so a rule session is pointed at a
+remote server by a single ``server_addr=`` argument — the in-process
+store remains the fast local path.
+
+Transport: ``multiprocessing.connection`` (stdlib) — length-prefixed
+pickled messages with HMAC challenge/response auth.  Parameter pytrees
+travel as numpy trees (the reference shipped flattened GPU buffers over
+MPI; ``utils/helper_funcs.tree_to_vector`` remains available for
+byte-exact wire framing, but pickle protocol 5 already moves numpy
+buffers without copies).  The authkey gates access (set
+``THEANOMPI_TPU_SERVICE_KEY``); run the service on a trusted network —
+pickle is not safe against a hostile peer even with auth.
+
+Launch:  ``python -m theanompi_tpu.parallel.service --port 45800``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+from multiprocessing.connection import Client, Connection, Listener
+from typing import Any
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+DEFAULT_PORT = 45800
+
+
+def _authkey() -> bytes:
+    return os.environ.get("THEANOMPI_TPU_SERVICE_KEY",
+                          "theanompi-tpu").encode()
+
+
+def _np(tree: PyTree) -> PyTree:
+    return jax.tree.map(np.asarray, tree)
+
+
+from theanompi_tpu.utils.helper_funcs import build_sgd_optimizer
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class ParamService:
+    """Dispatches wire ops onto lazily-created parameter stores.
+
+    Stores are scoped by a ``session_id``: the first ``*_init`` of a
+    new session id replaces the previous session's store, so a
+    long-lived ``tmserver`` serves consecutive training sessions
+    without inheriting stale state (a finished GOSGD session leaves its
+    hub fully deactivated; EASGD/ASGD would otherwise resume a dead
+    run's center).  Workers of ONE session — including other hosts —
+    must share the id (the rule generates one and hands it to every
+    worker client; multi-host operators pass ``--session-id``)."""
+
+    def __init__(self):
+        from theanompi_tpu.parallel.server import (
+            ASGDServer,
+            EASGDServer,
+            GossipHub,
+        )
+
+        self._classes = {"easgd": EASGDServer, "asgd": ASGDServer,
+                         "gosgd": GossipHub}
+        self._stores: dict[str, Any] = {}
+        self._sessions: dict[str, str] = {}
+        self._init_lock = threading.Lock()
+
+    def _fresh(self, kind: str, session_id: str) -> bool:
+        """True if the caller's init should (re)create the store —
+        first init of this session id wins; same-session peers join."""
+        if self._sessions.get(kind) == session_id:
+            return False
+        self._sessions[kind] = session_id
+        return True
+
+    def easgd_init(self, params: PyTree, alpha: float, session_id: str):
+        with self._init_lock:
+            if self._fresh("easgd", session_id):
+                self._stores["easgd"] = self._classes["easgd"](
+                    params, alpha=alpha)
+
+    def asgd_init(self, params: PyTree, opt_cfg: dict,
+                  opt_state: PyTree | None, session_id: str):
+        with self._init_lock:
+            if self._fresh("asgd", session_id):
+                tx = build_sgd_optimizer(**opt_cfg)
+                store = self._classes["asgd"](params, tx)
+                if opt_state is not None:  # resume
+                    store.set_opt_state(opt_state)
+                self._stores["asgd"] = store
+
+    def gosgd_init(self, n_workers: int, session_id: str):
+        with self._init_lock:
+            if self._fresh("gosgd", session_id):
+                self._stores["gosgd"] = self._classes["gosgd"](n_workers)
+
+    def _store(self, kind: str):
+        store = self._stores.get(kind)
+        if store is None:
+            raise RuntimeError(f"{kind} store not initialized; a worker "
+                               f"must send {kind}_init first")
+        return store
+
+    # -- dispatch --
+
+    def handle(self, op: str, *args):
+        if op in ("easgd_init", "asgd_init", "gosgd_init"):
+            return getattr(self, op)(*args)
+        if op == "easgd_exchange":
+            return _np(self._store("easgd").exchange(*args))
+        if op == "easgd_get_center":
+            return _np(self._store("easgd").get_center())
+        if op == "asgd_push_pull":
+            return _np(self._store("asgd").push_pull(*args))
+        if op == "asgd_set_lr":
+            return self._store("asgd").set_lr(*args)
+        if op == "asgd_get_center":
+            return _np(self._store("asgd").get_center())
+        if op == "asgd_get_opt_state":
+            return _np(self._store("asgd").get_opt_state())
+        if op == "gosgd_push":
+            return self._store("gosgd").push(*args)
+        if op == "gosgd_drain":
+            return self._store("gosgd").drain(*args)
+        if op == "gosgd_deactivate":
+            return self._store("gosgd").deactivate(*args)
+        if op == "stats":
+            out = {}
+            if "easgd" in self._stores:
+                out["n_exchanges"] = self._stores["easgd"].n_exchanges
+            if "asgd" in self._stores:
+                out["n_updates"] = self._stores["asgd"].n_updates
+            return out
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown op {op!r}")
+
+
+def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
+          ready_event: threading.Event | None = None,
+          stop_event: threading.Event | None = None) -> None:
+    """Run the service until a ``shutdown`` op (or ``stop_event``).
+    One handler thread per connection; each worker thread keeps its own
+    persistent connection, so worker exchanges proceed concurrently up
+    to the store's own lock."""
+    service = ParamService()
+    if stop_event is None:
+        stop_event = threading.Event()  # so the shutdown op works
+    listener = Listener((host, port), authkey=_authkey())
+    if ready_event is not None:
+        ready_event.set()
+
+    def handle_conn(conn: Connection):
+        with conn:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                if not isinstance(msg, tuple) or not msg:
+                    conn.send(("err", "malformed request"))
+                    continue
+                op, *args = msg
+                if op == "shutdown":
+                    conn.send(("ok", None))
+                    if stop_event is not None:
+                        stop_event.set()
+                    # unblock accept() so the serve loop exits
+                    try:
+                        Client((host if host != "0.0.0.0" else "127.0.0.1",
+                                port), authkey=_authkey()).close()
+                    except OSError:
+                        pass
+                    return
+                try:
+                    conn.send(("ok", service.handle(op, *args)))
+                except Exception as e:  # surfaced client-side
+                    conn.send(("err", f"{type(e).__name__}: {e}"))
+
+    from multiprocessing import AuthenticationError
+
+    with listener:
+        while stop_event is None or not stop_event.is_set():
+            try:
+                conn = listener.accept()
+            except AuthenticationError:
+                continue  # a bad-key peer must not kill the service
+            except OSError:
+                if stop_event is not None and stop_event.is_set():
+                    return
+                raise
+            threading.Thread(target=handle_conn, args=(conn,),
+                             daemon=True).start()
+
+
+# ---------------------------------------------------------------------------
+# Clients — duck-type the in-process stores (parallel/server.py)
+# ---------------------------------------------------------------------------
+
+
+class ServiceClient:
+    """One persistent authenticated connection; thread-safe call()."""
+
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        self.address = (host or "127.0.0.1", int(port))
+        self._conn = Client(self.address, authkey=_authkey())
+        self._lock = threading.Lock()
+
+    def call(self, op: str, *args):
+        with self._lock:
+            self._conn.send((op, *args))
+            status, payload = self._conn.recv()
+        if status != "ok":
+            raise RuntimeError(f"service error for {op}: {payload}")
+        return payload
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class RemoteEASGD(ServiceClient):
+    """EASGDServer API over the wire (rules/async_rules.py EASGD).
+
+    ``session_id`` scopes the server-side store: every worker client of
+    one training session passes the same id (first init creates the
+    center, peers join); a new id replaces a finished session's store.
+    """
+
+    def __init__(self, address: str, params: PyTree, alpha: float,
+                 session_id: str = "default"):
+        super().__init__(address)
+        self.call("easgd_init", _np(jax.device_get(params)), float(alpha),
+                  str(session_id))
+
+    def exchange(self, worker_params: PyTree) -> PyTree:
+        return self.call("easgd_exchange", _np(jax.device_get(worker_params)))
+
+    def get_center(self) -> PyTree:
+        return self.call("easgd_get_center")
+
+    @property
+    def n_exchanges(self) -> int:
+        return int(self.call("stats").get("n_exchanges", 0))
+
+
+class RemoteASGD(ServiceClient):
+    """ASGDServer API over the wire."""
+
+    def __init__(self, address: str, params: PyTree, opt_cfg: dict,
+                 opt_state: PyTree | None = None,
+                 session_id: str = "default"):
+        super().__init__(address)
+        self.call("asgd_init", _np(jax.device_get(params)), dict(opt_cfg),
+                  None if opt_state is None
+                  else _np(jax.device_get(opt_state)), str(session_id))
+
+    def push_pull(self, grads: PyTree) -> PyTree:
+        return self.call("asgd_push_pull", _np(jax.device_get(grads)))
+
+    def set_lr(self, lr: float) -> None:
+        self.call("asgd_set_lr", float(lr))
+
+    def get_center(self) -> PyTree:
+        return self.call("asgd_get_center")
+
+    def get_opt_state(self) -> PyTree:
+        return self.call("asgd_get_opt_state")
+
+    @property
+    def n_updates(self) -> int:
+        return int(self.call("stats").get("n_updates", 0))
+
+
+class RemoteGossipHub(ServiceClient):
+    """GossipHub API over the wire.  ``rank_offset`` maps this host's
+    local worker ranks onto the global gossip rank space when several
+    hosts share one hub."""
+
+    def __init__(self, address: str, n_workers: int, rank_offset: int = 0,
+                 session_id: str = "default"):
+        super().__init__(address)
+        self.n_workers = n_workers
+        self.rank_offset = rank_offset
+        self.call("gosgd_init", int(n_workers), str(session_id))
+
+    def push(self, dst: int, params: PyTree, weight: float) -> bool:
+        return self.call("gosgd_push", int(dst),
+                         _np(jax.device_get(params)), float(weight))
+
+    def drain(self, rank: int):
+        return self.call("gosgd_drain", int(rank + self.rank_offset))
+
+    def deactivate(self, rank: int) -> None:
+        self.call("gosgd_deactivate", int(rank + self.rank_offset))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="theanompi-tpu async-rule parameter service (DCN)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--platform", default=None,
+                    help="jax platform for the service's merge arithmetic "
+                         "(e.g. 'cpu' so the service never claims a chip)")
+    args = ap.parse_args(argv)
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    print(f"[service] listening on {args.host}:{args.port}", flush=True)
+    serve(args.host, args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,8 +25,10 @@ any worker exception aborts the session (SURVEY.md §5.3).
 
 from __future__ import annotations
 
+import json
 import os
 import threading
+import uuid
 from typing import Any
 
 import jax
@@ -36,8 +38,15 @@ from theanompi_tpu.models.base import TpuModel
 from theanompi_tpu.parallel.exchanger import gosgd_merge
 from theanompi_tpu.parallel.mesh import data_mesh, replicate
 from theanompi_tpu.parallel.server import ASGDServer, EASGDServer, GossipHub
+from theanompi_tpu.parallel.service import (
+    RemoteASGD,
+    RemoteEASGD,
+    RemoteGossipHub,
+    ServiceClient,
+)
 from theanompi_tpu.rules.base import Rule, resolve_model_class
 from theanompi_tpu.utils.checkpoint import Checkpointer
+from theanompi_tpu.utils.helper_funcs import load_params_npz, save_params_npz
 from theanompi_tpu.utils.recorder import Recorder
 
 PyTree = Any
@@ -90,11 +99,13 @@ class EASGD(_AsyncRule):
     def _session(self, devs, modelfile, modelclass, config, resume,
                  sync_type, tau: int = 10, alpha: float = 0.5,
                  max_epochs: int | None = None, checkpoint: bool = True,
-                 **kwargs):
+                 server_addr: str | None = None,
+                 session_id: str | None = None, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
         self.model = models[0]
         cfg = self.model.config
+        session_id = session_id or uuid.uuid4().hex
 
         ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, self.model.name)) \
             if checkpoint else None
@@ -112,7 +123,20 @@ class EASGD(_AsyncRule):
                     m.state = m.state.replace(
                         params=replicate(center0, m.mesh))
                     m.adjust_hyperp(start_epoch)
-        server = EASGDServer(models[0].state.params, alpha=alpha)
+        def connect():
+            """Each worker thread gets its OWN connection (the service
+            handles connections concurrently; one shared client would
+            serialize every exchange on the client lock).  In-process
+            mode all threads share the store object directly."""
+            if server_addr:
+                # DCN path: the center lives in a separate service
+                # process (possibly another machine) — parallel/service
+                return RemoteEASGD(server_addr, models[0].state.params,
+                                   alpha=alpha, session_id=session_id)
+            return server
+
+        server = (connect() if server_addr
+                  else EASGDServer(models[0].state.params, alpha=alpha))
         self.server = server
         n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
                                                                start_epoch + max_epochs)
@@ -125,29 +149,35 @@ class EASGD(_AsyncRule):
             model, recorder = models[rank], recorders[rank]
 
             def work(abort: threading.Event):
-                model.compile_iter_fns("avg")
-                it_total = 0
-                for epoch in range(start_epoch, n_epochs):
-                    n_iters = model.begin_epoch(epoch)
-                    for it in range(n_iters):
-                        if abort.is_set():
-                            return
-                        if it_total % tau == 0:
-                            recorder.start()
-                            new_params = server.exchange(model.state.params)
-                            model.state = model.state.replace(
-                                params=new_params)
-                            recorder.end("comm")
-                        model.train_iter(it, recorder)
-                        it_total += 1
-                    model._flush_metrics(recorder)
-                    model.adjust_hyperp(epoch + 1)
-                    if rank == 0:
-                        epoch_done.release()
-                # final elastic sync so worker state ~ center
-                model.state = model.state.replace(
-                    params=server.exchange(model.state.params))
-                model.cleanup()
+                srv = connect()
+                try:
+                    model.compile_iter_fns("avg")
+                    it_total = 0
+                    for epoch in range(start_epoch, n_epochs):
+                        n_iters = model.begin_epoch(epoch)
+                        for it in range(n_iters):
+                            if abort.is_set():
+                                return
+                            if it_total % tau == 0:
+                                recorder.start()
+                                new_params = srv.exchange(
+                                    model.state.params)
+                                model.state = model.state.replace(
+                                    params=new_params)
+                                recorder.end("comm")
+                            model.train_iter(it, recorder)
+                            it_total += 1
+                        model._flush_metrics(recorder)
+                        model.adjust_hyperp(epoch + 1)
+                        if rank == 0:
+                            epoch_done.release()
+                    # final elastic sync so worker state ~ center
+                    model.state = model.state.replace(
+                        params=srv.exchange(model.state.params))
+                finally:
+                    model.cleanup()
+                    if srv is not server and isinstance(srv, ServiceClient):
+                        srv.close()
 
             return work
 
@@ -181,16 +211,20 @@ class EASGD(_AsyncRule):
                 val_recorder.epoch_summary(epoch, val.get("loss"),
                                            val.get("error"))
 
-        self._run_worker_threads(
-            [make_worker(i) for i in range(len(models))] + [orchestrate])
-        if ckpt is not None:
-            ckpt.close()
-        self.result = {
-            "val": val_results[-1] if val_results else {},
-            "val_curve": val_results,
-            "n_exchanges": server.n_exchanges,
-            "center": server.get_center(),
-        }
+        try:
+            self._run_worker_threads(
+                [make_worker(i) for i in range(len(models))] + [orchestrate])
+            self.result = {
+                "val": val_results[-1] if val_results else {},
+                "val_curve": val_results,
+                "n_exchanges": server.n_exchanges,
+                "center": server.get_center(),
+            }
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+            if isinstance(server, ServiceClient):
+                server.close()
 
 
 class ASGD(_AsyncRule):
@@ -200,19 +234,59 @@ class ASGD(_AsyncRule):
 
     def _session(self, devs, modelfile, modelclass, config, resume,
                  sync_type, max_epochs: int | None = None,
-                 checkpoint: bool = True, **kwargs):
-        if resume:
-            raise NotImplementedError(
-                "ASGD resume is not implemented yet; restart from scratch "
-                "or use BSP/EASGD which support --resume")
+                 checkpoint: bool = True, server_addr: str | None = None,
+                 session_id: str | None = None, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
         self.model = models[0]
         cfg = self.model.config
-        server = ASGDServer(models[0].state.params, models[0].tx)
+        session_id = session_id or uuid.uuid4().hex
+
+        # checkpoint/resume: the SERVER's center+opt_state are the
+        # training state under ASGD (workers' own opt_states are
+        # unused); stored in the canonical cross-rule payload shape
+        ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, self.model.name)) \
+            if checkpoint else None
+        start_epoch = 0
+        restored_opt = None
+        if resume:
+            if ckpt is None:
+                raise ValueError("resume=True requires checkpoint=True")
+            latest = ckpt.latest_epoch()
+            if latest is not None:
+                payload = ckpt.restore(latest, like={
+                    "state": models[0].state, "epoch": 0})
+                start_epoch = int(payload["epoch"]) + 1
+                center0 = jax.device_get(payload["state"].params)
+                restored_opt = jax.device_get(payload["state"].opt_state)
+                for m in models:
+                    m.state = m.state.replace(
+                        params=replicate(center0, m.mesh))
+                    m.adjust_hyperp(start_epoch)
+
+        def connect():
+            """Own connection per worker thread (see EASGD.connect)."""
+            if server_addr:
+                return RemoteASGD(server_addr, models[0].state.params,
+                                  models[0].optimizer_hyperparams(),
+                                  opt_state=restored_opt,
+                                  session_id=session_id)
+            return server
+
+        if server_addr:
+            server = connect()
+        else:
+            server = ASGDServer(jax.device_get(models[0].state.params),
+                                models[0].tx)
+            if restored_opt is not None:
+                server.set_opt_state(restored_opt)
         self.server = server
-        n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
-                                                               max_epochs)
+        if resume and start_epoch:
+            # the restored opt_state carries the old LR; apply the
+            # fast-forwarded schedule to the server (LR lives there)
+            server.set_lr(models[0].adjust_hyperp(start_epoch))
+        n_epochs = cfg.n_epochs if max_epochs is None else min(
+            cfg.n_epochs, start_epoch + max_epochs)
         recorders = [Recorder(rank=i, size=len(devs),
                               print_freq=cfg.print_freq)
                      for i in range(len(models))]
@@ -221,45 +295,68 @@ class ASGD(_AsyncRule):
             model, recorder = models[rank], recorders[rank]
 
             def work(abort: threading.Event):
-                gstep = model.compile_grad_fn()
-                for epoch in range(n_epochs):
-                    n_iters = model.begin_epoch(epoch)
-                    for it in range(n_iters):
-                        if abort.is_set():
-                            return
-                        recorder.start()
-                        batch = next(model._train_iter)
-                        recorder.end("wait")
-                        recorder.start()
-                        grads, new_ms, metrics = gstep(model.state, batch,
-                                                       model._next_rng())
-                        recorder.end("calc", block_on=metrics)
-                        recorder.start()
-                        fresh = server.push_pull(grads)
-                        model.state = model.state.replace(
-                            params=replicate(fresh, model.mesh),
-                            model_state=new_ms)
-                        recorder.end("comm")
-                        recorder.train_metrics(float(metrics["loss"]),
-                                               float(metrics["error"]),
-                                               model.global_batch)
-                    new_lr = model.adjust_hyperp(epoch + 1)
-                    if rank == 0:
-                        # the server's optimizer applies the updates, so
-                        # the schedule must reach IT (workers' own
-                        # opt_states are unused under ASGD)
-                        server.set_lr(new_lr)
-                model.cleanup()
+                srv = connect()
+                try:
+                    gstep = model.compile_grad_fn()
+                    for epoch in range(start_epoch, n_epochs):
+                        n_iters = model.begin_epoch(epoch)
+                        for it in range(n_iters):
+                            if abort.is_set():
+                                return
+                            recorder.start()
+                            batch = next(model._train_iter)
+                            recorder.end("wait")
+                            recorder.start()
+                            grads, new_ms, metrics = gstep(
+                                model.state, batch, model._next_rng())
+                            recorder.end("calc", block_on=metrics)
+                            recorder.start()
+                            fresh = srv.push_pull(grads)
+                            model.state = model.state.replace(
+                                params=replicate(fresh, model.mesh),
+                                model_state=new_ms)
+                            recorder.end("comm")
+                            recorder.train_metrics(float(metrics["loss"]),
+                                                   float(metrics["error"]),
+                                                   model.global_batch)
+                        new_lr = model.adjust_hyperp(epoch + 1)
+                        if rank == 0:
+                            # the server's optimizer applies the updates,
+                            # so the schedule must reach IT (workers' own
+                            # opt_states are unused under ASGD)
+                            srv.set_lr(new_lr)
+                            if ckpt is not None:
+                                ckpt.save(epoch, {
+                                    "state": model.state.replace(
+                                        params=jax.device_get(
+                                            srv.get_center()),
+                                        opt_state=jax.device_get(
+                                            srv.get_opt_state()),
+                                    ),
+                                    "epoch": epoch,
+                                })
+                finally:
+                    model.cleanup()
+                    if srv is not server and isinstance(srv, ServiceClient):
+                        srv.close()
 
             return work
 
-        self._run_worker_threads([make_worker(i) for i in range(len(models))])
-        center = jax.device_get(server.get_center())
+        try:
+            self._run_worker_threads(
+                [make_worker(i) for i in range(len(models))])
+            center = jax.device_get(server.get_center())
+            n_updates = server.n_updates
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+            if isinstance(server, ServiceClient):
+                server.close()
         probe = models[0]
         probe.compile_iter_fns("avg")
         probe.state = probe.state.replace(params=replicate(center, probe.mesh))
         val = probe.val_epoch(recorders[0])
-        self.result = {"val": val, "n_updates": server.n_updates,
+        self.result = {"val": val, "n_updates": n_updates,
                        "center": center}
 
 
@@ -270,37 +367,120 @@ class GOSGD(_AsyncRule):
 
     def _session(self, devs, modelfile, modelclass, config, resume,
                  sync_type, p_push: float = 0.1,
-                 max_epochs: int | None = None, **kwargs):
-        if resume:
-            raise NotImplementedError(
-                "GOSGD resume is not implemented yet; restart from scratch "
-                "or use BSP/EASGD which support --resume")
+                 max_epochs: int | None = None,
+                 checkpoint: bool = True,
+                 server_addr: str | None = None,
+                 n_total_workers: int | None = None,
+                 rank_offset: int = 0,
+                 session_id: str | None = None, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
         self.model = models[0]
         cfg = self.model.config
         n = len(models)
-        hub = GossipHub(n)
-        n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
-                                                               max_epochs)
+        session_id = session_id or uuid.uuid4().hex
+        # DCN path: several hosts share one gossip hub in a service
+        # process; this host's local workers occupy global ranks
+        # [rank_offset, rank_offset + n) of n_total_workers
+        n_total = n_total_workers if n_total_workers is not None else n
+
+        def connect():
+            """Own connection per worker thread (see EASGD.connect)."""
+            if server_addr:
+                return RemoteGossipHub(server_addr, n_total,
+                                       rank_offset=rank_offset,
+                                       session_id=session_id)
+            return hub
+
+        if server_addr:
+            hub = connect()
+        else:
+            if n_total != n or rank_offset:
+                raise ValueError("n_total_workers/rank_offset need "
+                                 "server_addr (the shared gossip hub)")
+            hub = GossipHub(n)
         recorders = [Recorder(rank=i, size=n, print_freq=cfg.print_freq)
                      for i in range(n)]
-        weights = [1.0 / n] * n  # gossip weights, renormalized by merges
+        # gossip weights (global invariant: sum over ALL workers == 1)
+        weights = [1.0 / n_total] * n
+
+        # -- checkpoint/resume (VERDICT r1 #5): canonical cross-rule
+        # payload holds worker 0's params (a legitimate model state);
+        # per-worker params + gossip weights ride sidecar npz/json so a
+        # GOSGD resume restores every worker exactly.  A checkpoint
+        # from another rule (no sidecars) still resumes: all workers
+        # start from its params with equal weights.
+        ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, self.model.name)) \
+            if checkpoint else None
+        sidecar_dir = os.path.join(cfg.snapshot_dir, self.model.name)
+        start_epoch = 0
+        if resume:
+            if ckpt is None:
+                raise ValueError("resume=True requires checkpoint=True")
+            latest = ckpt.latest_epoch()
+            if latest is not None:
+                payload = ckpt.restore(latest, like={
+                    "state": models[0].state, "epoch": 0})
+                start_epoch = int(payload["epoch"]) + 1
+                meta_path = os.path.join(sidecar_dir,
+                                         f"gosgd_meta_{latest}.json")
+                worker_paths = [os.path.join(sidecar_dir,
+                                             f"gosgd_w{i}_{latest}.npz")
+                                for i in range(n)]
+                meta = None
+                if os.path.exists(meta_path):
+                    with open(meta_path) as f:
+                        meta = json.load(f)
+                if (meta is not None
+                        and meta.get("n_workers") == n
+                        and all(os.path.exists(p) for p in worker_paths)):
+                    # the snapshot was taken mid-session, when some
+                    # gossip weight was in flight in peers' inboxes —
+                    # renormalize to this host's share so the global
+                    # sum-of-weights == 1 invariant is re-established
+                    restored = [float(w) for w in meta["weights"]]
+                    share = n / n_total
+                    s = sum(restored)
+                    weights[:] = [w / s * share for w in restored]
+                    for m, p in zip(models, worker_paths):
+                        like = jax.tree.map(np.asarray, m.state.params)
+                        m.state = m.state.replace(params=replicate(
+                            load_params_npz(p, like), m.mesh))
+                else:  # cross-rule ckpt or worker-count change:
+                    # consensus start at equal weights
+                    center0 = jax.device_get(payload["state"].params)
+                    for m in models:
+                        m.state = m.state.replace(
+                            params=replicate(center0, m.mesh))
+                for m in models:
+                    m.adjust_hyperp(start_epoch)
+        n_epochs = cfg.n_epochs if max_epochs is None else min(
+            cfg.n_epochs, start_epoch + max_epochs)
 
         def make_worker(rank: int):
             model, recorder = models[rank], recorders[rank]
-            rng = np.random.default_rng(cfg.seed + 31 * rank)
+            rng = np.random.default_rng(cfg.seed + 31 * (rank + rank_offset))
+            g_rank = rank + rank_offset
 
             def work(abort: threading.Event):
+                h = connect()
+                try:
+                    gosgd_loop(h, abort)
+                finally:
+                    model.cleanup()
+                    if h is not hub and isinstance(h, ServiceClient):
+                        h.close()
+
+            def gosgd_loop(h, abort):
                 model.compile_iter_fns("avg")
-                for epoch in range(n_epochs):
+                for epoch in range(start_epoch, n_epochs):
                     n_iters = model.begin_epoch(epoch)
                     for it in range(n_iters):
                         if abort.is_set():
                             return
                         # merge anything gossiped to us
                         recorder.start()
-                        for recv_params, recv_w in hub.drain(rank):
+                        for recv_params, recv_w in h.drain(rank):
                             merged, new_w = gosgd_merge(
                                 model.state.params, weights[rank],
                                 recv_params, recv_w)
@@ -309,41 +489,68 @@ class GOSGD(_AsyncRule):
                         recorder.end("comm")
                         model.train_iter(it, recorder)
                         # push with probability p to a random peer
-                        if n > 1 and rng.random() < p_push:
-                            dst = int(rng.integers(0, n - 1))
-                            dst = dst if dst < rank else dst + 1
+                        # (global rank space when hosts share a hub)
+                        if n_total > 1 and rng.random() < p_push:
+                            dst = int(rng.integers(0, n_total - 1))
+                            dst = dst if dst < g_rank else dst + 1
                             recorder.start()
                             half = weights[rank] / 2.0
-                            if hub.push(dst, model.state.params, half):
+                            if h.push(dst, model.state.params, half):
                                 weights[rank] = half
                             recorder.end("comm")
                     model._flush_metrics(recorder)
                     model.adjust_hyperp(epoch + 1)
-                hub.deactivate(rank)
-                model.cleanup()
+                    if ckpt is not None:
+                        # each worker snapshots its OWN params from its
+                        # own thread — another thread's state may be
+                        # donated by its in-flight train step at any
+                        # moment (cross-worker reads race with XLA
+                        # buffer donation); slight cross-worker epoch
+                        # skew is inherent to the async rule
+                        own = jax.device_get(model.state.params)
+                        save_params_npz(os.path.join(
+                            sidecar_dir, f"gosgd_w{rank}_{epoch}.npz"), own)
+                        if rank == 0:
+                            ckpt.save(epoch, {
+                                "state": model.state.replace(params=own),
+                                "epoch": epoch,
+                            })
+                            with open(os.path.join(
+                                    sidecar_dir,
+                                    f"gosgd_meta_{epoch}.json"), "w") as f:
+                                json.dump({"epoch": epoch, "n_workers": n,
+                                           "weights": list(weights)}, f)
+                h.deactivate(rank)
 
             return work
 
-        self._run_worker_threads([make_worker(i) for i in range(n)])
-        # merge whatever was still in flight at shutdown (conserves the
-        # gossip weight), then fold the weighted consensus
-        for rank in range(n):
-            for recv_params, recv_w in hub.drain(rank):
-                merged, new_w = gosgd_merge(
-                    jax.device_get(models[rank].state.params), weights[rank],
-                    recv_params, recv_w)
-                models[rank].state = models[rank].state.replace(
-                    params=replicate(jax.device_get(merged),
-                                     models[rank].mesh))
-                weights[rank] = float(new_w)
-        # consensus = weight-averaged params across workers (fetched to
-        # host first — each worker's params are committed to its device)
-        consensus = jax.device_get(models[0].state.params)
-        acc_w = weights[0]
-        for i in range(1, n):
-            consensus, acc_w = gosgd_merge(
-                consensus, acc_w, jax.device_get(models[i].state.params),
-                weights[i])
+        try:
+            self._run_worker_threads([make_worker(i) for i in range(n)])
+            # merge whatever was still in flight at shutdown (conserves
+            # the gossip weight), then fold the weighted consensus
+            for rank in range(n):
+                for recv_params, recv_w in hub.drain(rank):
+                    merged, new_w = gosgd_merge(
+                        jax.device_get(models[rank].state.params),
+                        weights[rank], recv_params, recv_w)
+                    models[rank].state = models[rank].state.replace(
+                        params=replicate(jax.device_get(merged),
+                                         models[rank].mesh))
+                    weights[rank] = float(new_w)
+            # consensus = weight-averaged params across workers (fetched
+            # to host first — each worker's params are committed to its
+            # device)
+            consensus = jax.device_get(models[0].state.params)
+            acc_w = weights[0]
+            for i in range(1, n):
+                consensus, acc_w = gosgd_merge(
+                    consensus, acc_w,
+                    jax.device_get(models[i].state.params), weights[i])
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+            if isinstance(hub, ServiceClient):
+                hub.close()
         probe = models[0]
         probe.compile_iter_fns("avg")
         probe.state = probe.state.replace(

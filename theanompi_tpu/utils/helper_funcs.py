@@ -37,6 +37,25 @@ def scale_lr(lr: float, size: int, mode: str = "linear") -> float:
     raise ValueError(f"unknown lr scaling mode {mode!r}")
 
 
+def build_sgd_optimizer(learning_rate: float, momentum: float = 0.0,
+                        nesterov: bool = False, weight_decay: float = 0.0):
+    """The framework's standard SGD chain (decoupled weight decay +
+    momentum SGD, lr mutable via inject_hyperparams) from plain
+    hyperparams — shared by TpuModel and the remote ASGD service, which
+    must rebuild the worker's optimizer from an init message (optax
+    transforms hold closures and do not pickle)."""
+
+    def make(learning_rate):
+        parts = []
+        if weight_decay:
+            parts.append(optax.add_decayed_weights(weight_decay))
+        parts.append(optax.sgd(learning_rate, momentum=momentum or None,
+                               nesterov=nesterov))
+        return optax.chain(*parts)
+
+    return optax.inject_hyperparams(make)(learning_rate=learning_rate)
+
+
 def set_learning_rate(opt_state: PyTree, lr: float) -> PyTree:
     """Return a copy of an ``optax.inject_hyperparams`` optimizer state
     with its learning rate rewritten — pure and structure-preserving, so
