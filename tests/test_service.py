@@ -168,3 +168,23 @@ def test_easgd_with_server_in_separate_process(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_session_scoping_and_displacement(local_service):
+    """A new session id replaces the store; the displaced session's ops
+    fail FAST instead of silently hitting the new store; same-session
+    workers join without re-shipping params."""
+    p = {"w": np.zeros(2, np.float32)}
+    s1 = RemoteEASGD(local_service, p, alpha=0.5, session_id="a")
+    worker = RemoteEASGD(local_service, None, alpha=0.5, session_id="a")
+    out = worker.exchange({"w": np.ones(2, np.float32)})
+    np.testing.assert_allclose(out["w"], 0.5)
+
+    s2 = RemoteEASGD(local_service, p, alpha=0.5, session_id="b")
+    with pytest.raises(RuntimeError, match="displaced"):
+        s1.exchange({"w": np.ones(2, np.float32)})
+    with pytest.raises(RuntimeError, match="not active"):
+        RemoteEASGD(local_service, None, alpha=0.5, session_id="zzz")
+    s2.exchange({"w": np.ones(2, np.float32)})  # live session still works
+    for c in (s1, worker, s2):
+        c.close()

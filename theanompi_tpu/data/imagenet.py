@@ -291,14 +291,27 @@ class ImageNet_data(Dataset):
         return n_mine // global_batch
 
 
+def _update_manifest(out_dir: str, entries: dict[str, int]) -> None:
+    """manifest.json maps shard basename -> sample count so
+    training-time init never re-scans shard files."""
+    import json
+
+    manifest_path = os.path.join(out_dir, "manifest.json")
+    manifest = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    manifest.update(entries)
+    with open(manifest_path, "w") as fh:
+        json.dump(manifest, fh)
+
+
 def prepare_imagenet_shards(src_images: np.ndarray, src_labels: np.ndarray,
                             out_dir: str, prefix: str = "train",
                             shard_size: int = 1024) -> list[str]:
     """Offline prep: pack (N,H,W,3) uint8 images + labels into
     ``{prefix}_NNNN.npz`` shard files — the rebuild's analogue of the
     reference's hickle pre-processing scripts (SURVEY.md §2.9)."""
-    import json
-
     os.makedirs(out_dir, exist_ok=True)
     paths = []
     for i in range(0, len(src_labels), shard_size):
@@ -306,15 +319,140 @@ def prepare_imagenet_shards(src_images: np.ndarray, src_labels: np.ndarray,
         np.savez(p, x=src_images[i:i + shard_size],
                  y=src_labels[i:i + shard_size])
         paths.append(p)
-    # maintain manifest.json so training-time init never scans shards
-    manifest_path = os.path.join(out_dir, "manifest.json")
-    manifest = {}
-    if os.path.exists(manifest_path):
-        with open(manifest_path) as fh:
-            manifest = json.load(fh)
-    for k, p in enumerate(paths):
-        manifest[os.path.basename(p)] = int(
-            min(shard_size, len(src_labels) - k * shard_size))
-    with open(manifest_path, "w") as fh:
-        json.dump(manifest, fh)
+    _update_manifest(out_dir, {
+        os.path.basename(p): int(min(shard_size, len(src_labels) - k * shard_size))
+        for k, p in enumerate(paths)})
+    return paths
+
+
+IMAGE_EXTENSIONS = (".jpeg", ".jpg", ".png", ".bmp", ".webp")
+
+
+def list_image_dir(src_dir: str,
+                   class_to_idx: dict[str, int] | None = None,
+                   extensions: Sequence[str] = IMAGE_EXTENSIONS,
+                   ) -> tuple[list[tuple[str, int]], dict[str, int]]:
+    """Enumerate an ImageNet-style directory (one subdirectory per
+    class, e.g. wnids) into (path, label) pairs.  Labels come from
+    ``class_to_idx`` or the sorted subdirectory names — the same
+    convention as the standard ImageFolder layout, so a real ImageNet
+    train/ tree works unchanged."""
+    classes = sorted(d for d in os.listdir(src_dir)
+                     if os.path.isdir(os.path.join(src_dir, d)))
+    if not classes:
+        raise FileNotFoundError(
+            f"{src_dir!r} has no class subdirectories (expected "
+            "<src_dir>/<class>/<image>.jpeg, the ImageFolder layout)")
+    if class_to_idx is None:
+        class_to_idx = {c: i for i, c in enumerate(classes)}
+    pairs = []
+    for c in classes:
+        if c not in class_to_idx:
+            raise KeyError(f"directory {c!r} missing from class_to_idx")
+        cdir = os.path.join(src_dir, c)
+        for f in sorted(os.listdir(cdir)):
+            if f.lower().endswith(tuple(extensions)):
+                pairs.append((os.path.join(cdir, f), class_to_idx[c]))
+    return pairs, class_to_idx
+
+
+def decode_image(path: str, store: int) -> np.ndarray:
+    """JPEG/PNG -> uint8 (store, store, 3): RGB, shorter side resized
+    to ``store``, center crop — the reference's hickle prep stored
+    256x256 center crops of the shorter-side-256 resize the same way
+    (SURVEY.md §2.9)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        scale = store / min(w, h)
+        im = im.resize((max(store, round(w * scale)),
+                        max(store, round(h * scale))), Image.BILINEAR)
+        left = (im.width - store) // 2
+        top = (im.height - store) // 2
+        im = im.crop((left, top, left + store, top + store))
+        return np.asarray(im, np.uint8)
+
+
+def _bounded_thread_map(fn: Callable, items: Sequence, workers: int,
+                        window: int) -> Iterator:
+    """``ThreadPoolExecutor.map`` with BACKPRESSURE: at most ``window``
+    decode results in flight, so a slow consumer (shard writes to a
+    network fs) cannot make 1.28M decoded images pile up in RAM
+    (``Executor.map`` submits everything eagerly; its ``chunksize`` is
+    process-pool-only)."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        pending: deque = deque()
+        for item in items:
+            pending.append(pool.submit(fn, item))
+            if len(pending) >= window:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+
+
+def prepare_imagenet_from_images(src_dir: str, out_dir: str,
+                                 prefix: str = "train", store: int = 256,
+                                 shard_size: int = 1024,
+                                 class_to_idx: dict[str, int] | None = None,
+                                 workers: int = 8,
+                                 shuffle_seed: int | None = 0) -> list[str]:
+    """Raw image directory -> resized npz shards + manifest (VERDICT r1
+    next-round #8): the full analogue of the reference's raw-JPEG hickle
+    preparation.  Decodes in a thread pool (PIL releases the GIL in
+    libjpeg), streams into fixed-size shards so ImageNet never has to
+    fit in RAM, and records the class mapping in ``classes.json``.
+
+    ``shuffle_seed`` shuffles the global file order once at prep time
+    (class subdirectories are otherwise contiguous, which would make
+    early training batches single-class even after training-time
+    file-order shuffling); None keeps directory order.
+    """
+    import json
+
+    try:
+        import PIL  # noqa: F401
+    except ImportError as e:  # pragma: no cover - PIL is in this env
+        raise RuntimeError(
+            "raw-image preparation needs Pillow; pre-decode with "
+            "prepare_imagenet_shards(images, labels, ...) instead") from e
+
+    pairs, class_to_idx = list_image_dir(src_dir, class_to_idx)
+    if shuffle_seed is not None:
+        order = np.random.default_rng(shuffle_seed).permutation(len(pairs))
+        pairs = [pairs[i] for i in order]
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "classes.json"), "w") as fh:
+        json.dump(class_to_idx, fh)
+
+    paths: list[str] = []
+    counts: dict[str, int] = {}
+    buf_x = np.empty((shard_size, store, store, 3), np.uint8)
+    buf_y = np.empty(shard_size, np.int32)
+    fill = 0
+
+    def flush():
+        nonlocal fill
+        p = os.path.join(out_dir, f"{prefix}_{len(paths):04d}.npz")
+        np.savez(p, x=buf_x[:fill], y=buf_y[:fill])
+        paths.append(p)
+        counts[os.path.basename(p)] = fill
+        fill = 0
+
+    decoded = _bounded_thread_map(
+        lambda pl: (decode_image(pl[0], store), pl[1]), pairs,
+        workers=workers, window=workers * 4)
+    for img, label in decoded:
+        buf_x[fill] = img
+        buf_y[fill] = label
+        fill += 1
+        if fill == shard_size:
+            flush()
+    if fill:
+        flush()
+    _update_manifest(out_dir, counts)
     return paths

@@ -114,36 +114,36 @@ class ParamService:
             if self._fresh("gosgd", session_id):
                 self._stores["gosgd"] = self._classes["gosgd"](n_workers)
 
-    def _store(self, kind: str):
+    def join(self, kind: str, session_id: str):
+        """Cheap membership check for non-creator workers: validates
+        the session exists WITHOUT re-shipping the init payload (N
+        workers x full param tree would be redundant wire traffic)."""
+        with self._init_lock:
+            if self._sessions.get(kind) != session_id:
+                raise RuntimeError(
+                    f"{kind} session {session_id!r} is not active on this "
+                    "service; the session creator must init first")
+
+    def _store(self, kind: str, session_id: str):
+        """Fail FAST when the caller's session was displaced by a newer
+        init — silently serving the replacement store would corrupt
+        both trainings."""
         store = self._stores.get(kind)
         if store is None:
             raise RuntimeError(f"{kind} store not initialized; a worker "
                                f"must send {kind}_init first")
+        if self._sessions.get(kind) != session_id:
+            raise RuntimeError(
+                f"{kind} session {session_id!r} was displaced by session "
+                f"{self._sessions.get(kind)!r}; this training session is "
+                "stale (two sessions are sharing one service store)")
         return store
 
-    # -- dispatch --
+    # -- dispatch: store ops carry (op, session_id, *args) --
 
     def handle(self, op: str, *args):
-        if op in ("easgd_init", "asgd_init", "gosgd_init"):
+        if op in ("easgd_init", "asgd_init", "gosgd_init", "join"):
             return getattr(self, op)(*args)
-        if op == "easgd_exchange":
-            return _np(self._store("easgd").exchange(*args))
-        if op == "easgd_get_center":
-            return _np(self._store("easgd").get_center())
-        if op == "asgd_push_pull":
-            return _np(self._store("asgd").push_pull(*args))
-        if op == "asgd_set_lr":
-            return self._store("asgd").set_lr(*args)
-        if op == "asgd_get_center":
-            return _np(self._store("asgd").get_center())
-        if op == "asgd_get_opt_state":
-            return _np(self._store("asgd").get_opt_state())
-        if op == "gosgd_push":
-            return self._store("gosgd").push(*args)
-        if op == "gosgd_drain":
-            return self._store("gosgd").drain(*args)
-        if op == "gosgd_deactivate":
-            return self._store("gosgd").deactivate(*args)
         if op == "stats":
             out = {}
             if "easgd" in self._stores:
@@ -153,6 +153,25 @@ class ParamService:
             return out
         if op == "ping":
             return "pong"
+        sid, *rest = args
+        if op == "easgd_exchange":
+            return _np(self._store("easgd", sid).exchange(*rest))
+        if op == "easgd_get_center":
+            return _np(self._store("easgd", sid).get_center())
+        if op == "asgd_push_pull":
+            return _np(self._store("asgd", sid).push_pull(*rest))
+        if op == "asgd_set_lr":
+            return self._store("asgd", sid).set_lr(*rest)
+        if op == "asgd_get_center":
+            return _np(self._store("asgd", sid).get_center())
+        if op == "asgd_get_opt_state":
+            return _np(self._store("asgd", sid).get_opt_state())
+        if op == "gosgd_push":
+            return self._store("gosgd", sid).push(*rest)
+        if op == "gosgd_drain":
+            return self._store("gosgd", sid).drain(*rest)
+        if op == "gosgd_deactivate":
+            return self._store("gosgd", sid).deactivate(*rest)
         raise ValueError(f"unknown op {op!r}")
 
 
@@ -245,22 +264,31 @@ class ServiceClient:
 class RemoteEASGD(ServiceClient):
     """EASGDServer API over the wire (rules/async_rules.py EASGD).
 
-    ``session_id`` scopes the server-side store: every worker client of
-    one training session passes the same id (first init creates the
-    center, peers join); a new id replaces a finished session's store.
+    ``session_id`` scopes the server-side store: the session CREATOR
+    passes host-numpy ``params`` (first init of a new id creates the
+    center; a later id replaces a finished session's store); additional
+    worker clients of the same session pass ``params=None`` to join
+    without re-shipping the tree.  Every subsequent op carries the id —
+    a displaced session fails fast instead of training against a
+    stranger's center.
     """
 
-    def __init__(self, address: str, params: PyTree, alpha: float,
+    def __init__(self, address: str, params: PyTree | None, alpha: float,
                  session_id: str = "default"):
         super().__init__(address)
-        self.call("easgd_init", _np(jax.device_get(params)), float(alpha),
-                  str(session_id))
+        self._sid = str(session_id)
+        if params is None:
+            self.call("join", "easgd", self._sid)
+        else:
+            self.call("easgd_init", _np(jax.device_get(params)),
+                      float(alpha), self._sid)
 
     def exchange(self, worker_params: PyTree) -> PyTree:
-        return self.call("easgd_exchange", _np(jax.device_get(worker_params)))
+        return self.call("easgd_exchange", self._sid,
+                         _np(jax.device_get(worker_params)))
 
     def get_center(self) -> PyTree:
-        return self.call("easgd_get_center")
+        return self.call("easgd_get_center", self._sid)
 
     @property
     def n_exchanges(self) -> int:
@@ -268,27 +296,33 @@ class RemoteEASGD(ServiceClient):
 
 
 class RemoteASGD(ServiceClient):
-    """ASGDServer API over the wire."""
+    """ASGDServer API over the wire (see RemoteEASGD on sessions)."""
 
-    def __init__(self, address: str, params: PyTree, opt_cfg: dict,
+    def __init__(self, address: str, params: PyTree | None, opt_cfg: dict,
                  opt_state: PyTree | None = None,
                  session_id: str = "default"):
         super().__init__(address)
-        self.call("asgd_init", _np(jax.device_get(params)), dict(opt_cfg),
-                  None if opt_state is None
-                  else _np(jax.device_get(opt_state)), str(session_id))
+        self._sid = str(session_id)
+        if params is None:
+            self.call("join", "asgd", self._sid)
+        else:
+            self.call("asgd_init", _np(jax.device_get(params)),
+                      dict(opt_cfg),
+                      None if opt_state is None
+                      else _np(jax.device_get(opt_state)), self._sid)
 
     def push_pull(self, grads: PyTree) -> PyTree:
-        return self.call("asgd_push_pull", _np(jax.device_get(grads)))
+        return self.call("asgd_push_pull", self._sid,
+                         _np(jax.device_get(grads)))
 
     def set_lr(self, lr: float) -> None:
-        self.call("asgd_set_lr", float(lr))
+        self.call("asgd_set_lr", self._sid, float(lr))
 
     def get_center(self) -> PyTree:
-        return self.call("asgd_get_center")
+        return self.call("asgd_get_center", self._sid)
 
     def get_opt_state(self) -> PyTree:
-        return self.call("asgd_get_opt_state")
+        return self.call("asgd_get_opt_state", self._sid)
 
     @property
     def n_updates(self) -> int:
@@ -298,24 +332,28 @@ class RemoteASGD(ServiceClient):
 class RemoteGossipHub(ServiceClient):
     """GossipHub API over the wire.  ``rank_offset`` maps this host's
     local worker ranks onto the global gossip rank space when several
-    hosts share one hub."""
+    hosts share one hub (see RemoteEASGD on sessions; gosgd_init is
+    payload-free so every client may send it)."""
 
     def __init__(self, address: str, n_workers: int, rank_offset: int = 0,
                  session_id: str = "default"):
         super().__init__(address)
+        self._sid = str(session_id)
         self.n_workers = n_workers
         self.rank_offset = rank_offset
-        self.call("gosgd_init", int(n_workers), str(session_id))
+        self.call("gosgd_init", int(n_workers), self._sid)
 
     def push(self, dst: int, params: PyTree, weight: float) -> bool:
-        return self.call("gosgd_push", int(dst),
+        return self.call("gosgd_push", self._sid, int(dst),
                          _np(jax.device_get(params)), float(weight))
 
     def drain(self, rank: int):
-        return self.call("gosgd_drain", int(rank + self.rank_offset))
+        return self.call("gosgd_drain", self._sid,
+                         int(rank + self.rank_offset))
 
     def deactivate(self, rank: int) -> None:
-        self.call("gosgd_deactivate", int(rank + self.rank_offset))
+        self.call("gosgd_deactivate", self._sid,
+                  int(rank + self.rank_offset))
 
 
 def main(argv=None) -> int:

@@ -52,6 +52,23 @@ from theanompi_tpu.utils.recorder import Recorder
 PyTree = Any
 
 
+def _prune_gosgd_sidecars(sidecar_dir: str, kept: set[int]) -> None:
+    """Drop per-worker param npz / meta json for epochs the orbax
+    manager pruned (max_to_keep) — otherwise a long GOSGD run leaks a
+    full parameter set per worker per epoch."""
+    import glob
+    import re
+
+    for path in glob.glob(os.path.join(sidecar_dir, "gosgd_w*_*.npz")) + \
+            glob.glob(os.path.join(sidecar_dir, "gosgd_meta_*.json")):
+        m = re.search(r"_(\d+)\.(?:npz|json)$", path)
+        if m and int(m.group(1)) not in kept:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
 class _AsyncRule(Rule):
     """Shared scaffolding: N worker threads, one model per device."""
 
@@ -126,17 +143,26 @@ class EASGD(_AsyncRule):
         def connect():
             """Each worker thread gets its OWN connection (the service
             handles connections concurrently; one shared client would
-            serialize every exchange on the client lock).  In-process
+            serialize every exchange on the client lock).  Workers JOIN
+            the session (params=None): reading models[0].state from a
+            worker thread would race with worker 0's donating train
+            step, and re-shipping the tree N times is waste.  In-process
             mode all threads share the store object directly."""
             if server_addr:
                 # DCN path: the center lives in a separate service
                 # process (possibly another machine) — parallel/service
-                return RemoteEASGD(server_addr, models[0].state.params,
-                                   alpha=alpha, session_id=session_id)
+                return RemoteEASGD(server_addr, None, alpha=alpha,
+                                   session_id=session_id)
             return server
 
-        server = (connect() if server_addr
-                  else EASGDServer(models[0].state.params, alpha=alpha))
+        if server_addr:
+            # session creator: ship the initial center from the MAIN
+            # thread, before any worker's train step can donate it
+            server = RemoteEASGD(server_addr,
+                                 jax.device_get(models[0].state.params),
+                                 alpha=alpha, session_id=session_id)
+        else:
+            server = EASGDServer(models[0].state.params, alpha=alpha)
         self.server = server
         n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
                                                                start_epoch + max_epochs)
@@ -265,16 +291,20 @@ class ASGD(_AsyncRule):
                     m.adjust_hyperp(start_epoch)
 
         def connect():
-            """Own connection per worker thread (see EASGD.connect)."""
+            """Own connection per worker thread; workers join without a
+            payload (see EASGD.connect on the donation race + waste)."""
             if server_addr:
-                return RemoteASGD(server_addr, models[0].state.params,
+                return RemoteASGD(server_addr, None,
                                   models[0].optimizer_hyperparams(),
-                                  opt_state=restored_opt,
                                   session_id=session_id)
             return server
 
         if server_addr:
-            server = connect()
+            server = RemoteASGD(server_addr,
+                                jax.device_get(models[0].state.params),
+                                models[0].optimizer_hyperparams(),
+                                opt_state=restored_opt,
+                                session_id=session_id)
         else:
             server = ASGDServer(jax.device_get(models[0].state.params),
                                 models[0].tx)
@@ -520,6 +550,8 @@ class GOSGD(_AsyncRule):
                                     f"gosgd_meta_{epoch}.json"), "w") as f:
                                 json.dump({"epoch": epoch, "n_workers": n,
                                            "weights": list(weights)}, f)
+                            _prune_gosgd_sidecars(sidecar_dir,
+                                                  ckpt.kept_epochs())
                 h.deactivate(rank)
 
             return work

@@ -46,6 +46,11 @@ class Checkpointer:
     def latest_epoch(self) -> int | None:
         return self._mgr.latest_step()
 
+    def kept_epochs(self) -> set[int]:
+        """Epochs still on disk after max_to_keep pruning — callers
+        with sidecar files (GOSGD per-worker params) prune to match."""
+        return set(self._mgr.all_steps())
+
     def restore(self, epoch: int | None = None, like: PyTree | None = None) -> PyTree:
         if epoch is None:
             epoch = self.latest_epoch()

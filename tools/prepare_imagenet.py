@@ -1,0 +1,60 @@
+"""Offline ImageNet preparation CLI — raw image tree -> npz shards.
+
+The rebuild's analogue of the reference's hickle preprocessing scripts
+(SURVEY.md §2.9; mount empty, no file:line):
+
+    python tools/prepare_imagenet.py /data/imagenet/train out/ \
+        --prefix train --store 256 --shard-size 1024
+    python tools/prepare_imagenet.py /data/imagenet/val out/ \
+        --prefix val --classes out/classes.json
+
+Expects the ImageFolder layout (<src>/<class>/<img>.jpeg).  Pass the
+train run's ``classes.json`` to the val run so labels agree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("src_dir", help="raw image tree (<src>/<class>/*.jpeg)")
+    ap.add_argument("out_dir", help="shard output directory")
+    ap.add_argument("--prefix", default="train", choices=("train", "val"))
+    ap.add_argument("--store", type=int, default=256,
+                    help="stored image side (resize shorter side + center "
+                         "crop); training crops store->crop on the fly")
+    ap.add_argument("--shard-size", type=int, default=1024)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--classes", default=None,
+                    help="classes.json from a previous run (use the train "
+                         "run's mapping for val)")
+    ap.add_argument("--no-shuffle", action="store_true",
+                    help="keep directory order (default: one global "
+                         "shuffle so shards are class-mixed)")
+    args = ap.parse_args(argv)
+
+    from theanompi_tpu.data.imagenet import prepare_imagenet_from_images
+
+    class_to_idx = None
+    if args.classes:
+        with open(args.classes) as fh:
+            class_to_idx = json.load(fh)
+    t0 = time.monotonic()
+    paths = prepare_imagenet_from_images(
+        args.src_dir, args.out_dir, prefix=args.prefix, store=args.store,
+        shard_size=args.shard_size, class_to_idx=class_to_idx,
+        workers=args.workers,
+        shuffle_seed=None if args.no_shuffle else 0)
+    dt = time.monotonic() - t0
+    print(f"wrote {len(paths)} {args.prefix} shards to {args.out_dir} "
+          f"in {dt:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
