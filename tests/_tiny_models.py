@@ -12,3 +12,18 @@ class TinyCifar(Cifar10_model):
 
     def build_data(self):
         return Cifar10_data(synthetic_n=512, seed=self.config.seed)
+
+
+class StragglerTinyCifar(TinyCifar):
+    """Worker 0 sleeps every iteration, making it the session's
+    straggler — exercises the async rules' heterogeneous-worker-speed
+    behavior (EASGD validates on worker 0's epoch cadence)."""
+
+    straggler_sleep_s = 0.01
+
+    def train_iter(self, count, recorder):
+        if self.shard_rank == 0:
+            import time
+
+            time.sleep(self.straggler_sleep_s)
+        super().train_iter(count, recorder)

@@ -1,0 +1,145 @@
+"""Device-side augmentation (ops/augment.py): the TPU-native data-path
+inversion — host ships raw uint8, the jitted step crops/flips/
+normalizes (round-2 redesign; the 1-core host cannot augment at device
+rate)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.data.imagenet import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ImageNet_data,
+)
+from theanompi_tpu.data.utils import center_normalize
+from theanompi_tpu.ops.augment import make_device_augment
+
+
+def u8_images(n=8, hw=20, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (n, hw, hw, 3), np.uint8)
+
+
+class TestMakeDeviceAugment:
+    def test_train_shape_dtype_and_bounds(self):
+        t = make_device_augment(16, mean=IMAGENET_MEAN, std=IMAGENET_STD)
+        x = u8_images()
+        out = t(jnp.asarray(x), jax.random.key(0), train=True)
+        assert out.shape == (8, 16, 16, 3) and out.dtype == jnp.float32
+        # normalized uint8 stays within the analytic bounds
+        lo = (0.0 - max(IMAGENET_MEAN)) / min(IMAGENET_STD)
+        hi = (1.0 - min(IMAGENET_MEAN)) / min(IMAGENET_STD)
+        assert float(out.min()) >= lo - 1e-5
+        assert float(out.max()) <= hi + 1e-5
+
+    def test_train_deterministic_in_rng(self):
+        t = make_device_augment(16)
+        x = jnp.asarray(u8_images())
+        a = t(x, jax.random.key(7), train=True)
+        b = t(x, jax.random.key(7), train=True)
+        c = t(x, jax.random.key(8), train=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_eval_matches_host_center_normalize(self):
+        """The device eval path must agree with the host oracle
+        (data/utils.center_normalize) to fp32 tolerance."""
+        t = make_device_augment(16, mean=IMAGENET_MEAN, std=IMAGENET_STD)
+        x = u8_images(n=4)
+        got = np.asarray(t(jnp.asarray(x), None, train=False))
+        want = center_normalize(x, 16, 16, mean=IMAGENET_MEAN,
+                                std=IMAGENET_STD)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_crops_are_windows_of_source(self):
+        """Every train output must be an exact window (possibly
+        mirrored) of its source image."""
+        t = make_device_augment(16, flip=True)
+        x = u8_images(n=6, hw=20)
+        out = np.asarray(t(jnp.asarray(x), jax.random.key(3), train=True))
+        restored = np.rint(out * 255.0).astype(np.int64)
+        for i in range(len(x)):
+            found = False
+            for y0 in range(5):
+                for x0 in range(5):
+                    win = x[i, y0:y0 + 16, x0:x0 + 16].astype(np.int64)
+                    if (np.array_equal(win, restored[i])
+                            or np.array_equal(win[:, ::-1], restored[i])):
+                        found = True
+            assert found, f"crop {i} is not a window of its source"
+
+    def test_pad_reflect(self):
+        t = make_device_augment(20, pad=2, flip=False)
+        x = u8_images(n=2, hw=20)
+        out = t(jnp.asarray(x), jax.random.key(0), train=True)
+        assert out.shape == (2, 20, 20, 3)
+
+    def test_too_small_rejected(self):
+        t = make_device_augment(32)
+        with pytest.raises(ValueError):
+            t(jnp.asarray(u8_images(hw=20)), jax.random.key(0), train=True)
+
+
+class TestImageNetDeviceAugment:
+    def test_batches_stay_uint8_at_store_size(self):
+        d = ImageNet_data(crop=16, synthetic_n=128, synthetic_pool=8,
+                          synthetic_store=20, augment_on_device=True)
+        assert d.device_transform is not None
+        x, y = next(iter(d.train_batches(0, 32)))
+        assert x.dtype == np.uint8 and x.shape == (32, 20, 20, 3)
+        xv, _ = next(iter(d.val_batches(32)))
+        assert xv.dtype == np.uint8 and xv.shape == (32, 20, 20, 3)
+        # sample_shape still advertises the post-transform (crop) shape
+        assert d.sample_shape == (16, 16, 3)
+
+    def test_host_path_unchanged_by_default(self):
+        d = ImageNet_data(crop=16, synthetic_n=128, synthetic_pool=8,
+                          synthetic_store=20)
+        assert d.device_transform is None
+        x, _ = next(iter(d.train_batches(0, 32)))
+        assert x.dtype == np.float32 and x.shape == (32, 16, 16, 3)
+
+
+class TestEndToEnd:
+    def test_resnet_trains_on_device_augmented_batches(self, mesh8):
+        """Full BSP step over the 8-device mesh with uint8 batches:
+        the transform runs inside the jitted step, loss decreases-ish
+        (finite), eval path works."""
+        from theanompi_tpu.models.base import ModelConfig
+        from theanompi_tpu.models.resnet50 import ResNet50
+
+        class TinyResNet(ResNet50):
+            stage_sizes = (1, 1)
+
+            def build_module(self):
+                import flax.linen as nn
+
+                from theanompi_tpu.models.resnet50 import ResNet
+
+                return ResNet(stage_sizes=self.stage_sizes, width=8,
+                              n_classes=self.data.n_classes,
+                              dtype=self._compute_dtype())
+
+            def build_data(self):
+                return ImageNet_data(crop=16, synthetic_n=128,
+                                     synthetic_pool=8, synthetic_store=20,
+                                     augment_on_device=True,
+                                     seed=self.config.seed)
+
+        cfg = ModelConfig(batch_size=4, n_epochs=1, learning_rate=0.01,
+                          print_freq=0, augment_on_device=True)
+        m = TinyResNet(config=cfg, mesh=mesh8, verbose=False)
+        m.compile_iter_fns("avg")
+        from theanompi_tpu.utils.recorder import Recorder
+
+        rec = Recorder(rank=0, size=8, print_freq=0)
+        n = m.begin_epoch(0)
+        for it in range(min(n, 3)):
+            m.train_iter(it, rec)
+        m._flush_metrics(rec)
+        assert np.isfinite(rec.train_losses).all()
+        val = m.val_epoch(rec)
+        assert np.isfinite(val["loss"])
+        m.cleanup()

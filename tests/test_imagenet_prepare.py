@@ -1,6 +1,7 @@
 """Raw-image ingestion: JPEG tree -> npz shards -> training batches
 (VERDICT r1 next-round #8; reference hickle prep per SURVEY.md §2.9)."""
 
+import glob
 import json
 import os
 
@@ -79,10 +80,32 @@ def test_prepare_from_images_roundtrip(tmp_path):
     # (class 0) keep channel 0 dominant after normalization
     for xb, yb in batches:
         for img, label in zip(xb, yb):
-            chan = np.argmax([img[..., 0].mean() - (label == 0) * 0,
-                              img[..., 1].mean(),
-                              img[..., 2].mean()])
+            chan = np.argmax([img[..., c].mean() for c in range(3)])
             assert chan == label
+
+
+def test_prepare_rerun_removes_stale_shards(tmp_path):
+    """A second prep into the same out_dir must not leave the first
+    run's higher-numbered shards (training globs {prefix}_*.npz and
+    would silently mix stale data)."""
+    src_big = tmp_path / "raw_big"
+    src_small = tmp_path / "raw_small"
+    out = tmp_path / "shards"
+    os.makedirs(src_big)
+    os.makedirs(src_small)
+    make_jpeg_tree(str(src_big), n_classes=3, per_class=6)    # 18 imgs
+    make_jpeg_tree(str(src_small), n_classes=3, per_class=2)  # 6 imgs
+
+    prepare_imagenet_from_images(str(src_big), str(out), prefix="train",
+                                 store=24, shard_size=8, workers=2)
+    paths2 = prepare_imagenet_from_images(str(src_small), str(out),
+                                          prefix="train", store=24,
+                                          shard_size=8, workers=2)
+    on_disk = sorted(glob.glob(str(out / "train_*.npz")))
+    assert on_disk == sorted(paths2) and len(on_disk) == 1
+    with open(out / "manifest.json") as fh:
+        manifest = json.load(fh)
+    assert sum(manifest.values()) == 6
 
 
 def test_prepare_rejects_flat_dir(tmp_path):

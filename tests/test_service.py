@@ -188,3 +188,22 @@ def test_session_scoping_and_displacement(local_service):
     s2.exchange({"w": np.ones(2, np.float32)})  # live session still works
     for c in (s1, worker, s2):
         c.close()
+
+
+def test_malformed_requests_fail_cleanly():
+    """Unknown ops and old-protocol requests (no session id) must get
+    purposeful errors, not unpacking crashes or a params-tree-as-
+    session-id misdiagnosis."""
+    from theanompi_tpu.parallel.service import ParamService
+
+    svc = ParamService()
+    with pytest.raises(ValueError, match="unknown op"):
+        svc.handle("bogus_op")
+    with pytest.raises(ValueError, match="unknown op"):
+        svc.handle("bogus_op", "sid", 1, 2)
+    # known store op with no args at all
+    with pytest.raises(ValueError, match="session id"):
+        svc.handle("easgd_exchange")
+    # old-protocol client: first arg is the params tree, not a str id
+    with pytest.raises(ValueError, match="session"):
+        svc.handle("easgd_exchange", {"w": np.ones(2)})

@@ -33,6 +33,13 @@ class Dataset(abc.ABC):
     n_train: int
     n_val: int
 
+    #: optional jittable ``transform(x, rng, train) -> fp32`` applied to
+    #: each batch INSIDE the step (ops/augment.py).  When set, the host
+    #: iterators yield raw (e.g. uint8 store-size) images and the device
+    #: does crop/flip/normalize — honored by the default
+    #: ``TpuModel.loss_fn``/``eval_fn``.
+    device_transform = None
+
     @abc.abstractmethod
     def train_batches(
         self, epoch: int, global_batch: int, rank: int = 0, size: int = 1

@@ -107,7 +107,8 @@ class AlexNet(TpuModel):
     def build_data(self):
         # AlexNet trains on 227x227 crops (valid-padded 11x11/4 stem).
         return ImageNet_data(data_dir=self.config.data_dir, crop=227,
-                             seed=self.config.seed)
+                             seed=self.config.seed,
+                             augment_on_device=self.config.augment_on_device)
 
 
 # reference-style alias

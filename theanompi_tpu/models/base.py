@@ -92,6 +92,11 @@ class ModelConfig:
     exchange_strategy: str = "psum"        # reference names accepted (nccl16...)
     exchange_what: str = "grads"
     compute_dtype: str = "float32"         # 'bfloat16' -> MXU-friendly compute
+    #: crop/flip/normalize on DEVICE (ops/augment.py) — the host ships
+    #: raw uint8 and the step augments; False = host-side augmentation
+    #: (the reference's loader semantics).  Honored by the ImageNet
+    #: model family's build_data.
+    augment_on_device: bool = True
     seed: int = 42
     data_dir: str | None = None
     snapshot_dir: str = "./snapshots"
@@ -213,8 +218,16 @@ class TpuModel:
                 "nesterov": cfg.nesterov, "weight_decay": cfg.weight_decay}
 
     def loss_fn(self, params, model_state, batch, rng):
-        """Default: softmax CE + top-1 error.  Override for GANs etc."""
+        """Default: softmax CE + top-1 error.  Override for GANs etc.
+
+        Honors the dataset's ``device_transform`` (ops/augment.py):
+        raw uint8 batches are cropped/flipped/normalized on device as
+        part of this same jitted step."""
         x, y = batch
+        transform = getattr(self.data, "device_transform", None)
+        if transform is not None:
+            rng, aug_rng = jax.random.split(rng)
+            x = transform(x, aug_rng, train=True)
         variables = {"params": params, **model_state}
         mutable = [k for k in model_state if k == "batch_stats"]
         if mutable:
@@ -242,6 +255,9 @@ class TpuModel:
 
     def eval_fn(self, params, model_state, batch):
         x, y = batch
+        transform = getattr(self.data, "device_transform", None)
+        if transform is not None:
+            x = transform(x, None, train=False)  # center crop, no mirror
         variables = {"params": params, **model_state}
         logits = self.module.apply(variables, x, train=False)
         if isinstance(logits, (tuple, list)):
@@ -371,13 +387,25 @@ class TpuModel:
 
     def val_iter(self, count: int, recorder: Recorder,
                  batch=None) -> dict:
+        """One async eval dispatch, timed like the train path (the
+        returned metrics are device scalars; the caller fetches them in
+        bulk so the device pipeline never serializes per batch)."""
+        recorder.start()
         metrics = self.eval_step(self.state, batch)
+        recorder.end("calc")
         return metrics
 
+    #: max un-synced validation dispatches: bounds how many in-flight
+    #: batches' device buffers the runtime must pin (a full ImageNet val
+    #: epoch left unfenced would queue gigabytes of inputs)
+    VAL_SYNC_WINDOW = 8
+
     def val_epoch(self, recorder: Recorder) -> dict[str, float]:
-        """Full validation pass; returns averaged metrics."""
-        sums: dict[str, float] = {}
-        n = 0
+        """Full validation pass; returns averaged metrics.  Dispatches
+        eval steps asynchronously and syncs once per ``VAL_SYNC_WINDOW``
+        batches — the device pipeline stays busy without per-batch
+        serialization or unbounded buffer retention."""
+        pending: list[dict] = []
         if self.multiprocess:
             host_iter = self.data.host_val_batches(
                 self.global_batch, self.host_rank, self.host_count)
@@ -385,12 +413,20 @@ class TpuModel:
             host_iter = self.data.val_batches(self.global_batch)
         with DevicePrefetcher(host_iter, self.mesh,
                               spec=self.batch_partition) as pf:
-            for batch in pf:
-                m = self.val_iter(n, recorder, batch)
-                for k, v in m.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
-                n += 1
-        return {k: v / max(n, 1) for k, v in sums.items()}
+            for n, batch in enumerate(pf):
+                pending.append(self.val_iter(n, recorder, batch))
+                if (n + 1) % self.VAL_SYNC_WINDOW == 0:
+                    recorder.start()
+                    recorder.end("calc", block_on=pending[-1])
+        if not pending:
+            return {}
+        recorder.start()
+        sums: dict[str, float] = {}
+        for m in pending:
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+        recorder.end("calc", block_on=pending[-1])
+        return {k: v / len(pending) for k, v in sums.items()}
 
     def adjust_hyperp(self, epoch: int) -> float:
         """Per-epoch LR schedule (the reference's step/poly decay)."""

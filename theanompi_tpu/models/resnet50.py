@@ -115,7 +115,8 @@ class ResNet50(TpuModel):
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir,
-                             seed=self.config.seed)
+                             seed=self.config.seed,
+                             augment_on_device=self.config.augment_on_device)
 
 
 # reference-style alias (upstream files exposed Model-suffixed names too)

@@ -82,7 +82,8 @@ class VGG16(TpuModel):
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir, crop=224,
-                             seed=self.config.seed)
+                             seed=self.config.seed,
+                             augment_on_device=self.config.augment_on_device)
 
 
 # reference-style alias

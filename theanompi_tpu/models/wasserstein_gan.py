@@ -260,7 +260,12 @@ class Wasserstein_GAN(TpuModel):
         self.eval_step = jax.jit(eval_sharded)
 
     def val_iter(self, count: int, recorder: Recorder, batch=None) -> dict:
-        return self.eval_step(self.state, batch, self._next_rng())
+        # same self-timing contract as TpuModel.val_iter (val_epoch's
+        # caller no longer wraps validation in its own recorder section)
+        recorder.start()
+        metrics = self.eval_step(self.state, batch, self._next_rng())
+        recorder.end("calc")
+        return metrics
 
     def generate(self, n: int, seed: int = 0) -> np.ndarray:
         """Sample n images from the generator (host-side convenience)."""

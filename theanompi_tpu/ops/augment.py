@@ -1,0 +1,72 @@
+"""Device-side augmentation: crop + mirror + normalize inside the step.
+
+The reference did crop/flip on the host in its parallel loader
+(SURVEY.md §2.9/§3.4) because the GPU was busy and host cores were
+plentiful.  On this environment the economics invert: one host core
+cannot augment 2500+ img/s (measured: the fused native C++ kernel tops
+out ~1600 img/s), while the TPU's VPU does the same work in noise
+compared to the conv FLOPs.  So the TPU-native pipeline ships RAW
+uint8 store images (e.g. 256x256) to the device — 4x fewer H2D bytes
+than normalized fp32 crops — and the jitted train step crops, mirrors
+and normalizes on device.
+
+The transform is built once per dataset (``make_device_augment``) and
+applied by ``TpuModel.loss_fn``/``eval_fn`` when the dataset exposes
+it as ``device_transform``; randomness comes from the step rng, so the
+whole path stays one compiled SPMD program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_device_augment(crop: int, mean=None, std=None,
+                        divisor: float = 255.0, flip: bool = True,
+                        pad: int = 0):
+    """Build ``transform(x, rng, train) -> float32 (N, crop, crop, C)``.
+
+    Train: per-image random crop window + mirror-half (rng required).
+    Eval: deterministic center crop, no mirror (rng may be None).
+    Both normalize ``(x/divisor - mean)/std`` in fp32 (the model casts
+    to its compute dtype at the stem).
+    """
+    mean_a = None if mean is None else jnp.asarray(mean, jnp.float32)
+    std_a = None if std is None else jnp.asarray(std, jnp.float32)
+
+    def normalize(win):
+        win = win.astype(jnp.float32) / divisor
+        if mean_a is not None:
+            win = win - mean_a
+        if std_a is not None:
+            win = win / std_a
+        return win
+
+    def transform(x, rng, train: bool):
+        n, h, w, c = x.shape
+        if pad:
+            x = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                        mode="reflect")
+            h, w = h + 2 * pad, w + 2 * pad
+        if h < crop or w < crop:
+            raise ValueError(f"images {h}x{w} smaller than crop {crop}")
+        if train:
+            ky, kx, kf = jax.random.split(rng, 3)
+            ys = jax.random.randint(ky, (n,), 0, h - crop + 1)
+            xs = jax.random.randint(kx, (n,), 0, w - crop + 1)
+        else:
+            ys = jnp.full((n,), (h - crop) // 2, jnp.int32)
+            xs = jnp.full((n,), (w - crop) // 2, jnp.int32)
+
+        def slice_one(img, y0, x0):
+            return jax.lax.dynamic_slice(img, (y0, x0, 0), (crop, crop, c))
+
+        out = jax.vmap(slice_one)(x, ys, xs)
+        if train and flip:
+            flips = jax.random.bernoulli(kf, 0.5, (n,))
+            out = jnp.where(flips[:, None, None, None], out[:, :, ::-1, :],
+                            out)
+        return normalize(out)
+
+    return transform

@@ -153,6 +153,13 @@ class ParamService:
             return out
         if op == "ping":
             return "pong"
+        if op not in self.SESSION_OPS:
+            raise ValueError(f"unknown op {op!r}")
+        if not args or not isinstance(args[0], str):
+            raise ValueError(
+                f"{op} requires (session_id, ...) — got {len(args)} args "
+                "with no session id; the client may predate the "
+                "session-scoped protocol")
         sid, *rest = args
         if op == "easgd_exchange":
             return _np(self._store("easgd", sid).exchange(*rest))
@@ -172,7 +179,14 @@ class ParamService:
             return self._store("gosgd", sid).drain(*rest)
         if op == "gosgd_deactivate":
             return self._store("gosgd", sid).deactivate(*rest)
-        raise ValueError(f"unknown op {op!r}")
+        raise AssertionError(f"op {op!r} in SESSION_OPS but unhandled")
+
+    #: ops that carry (session_id, *args) — validated before unpacking
+    SESSION_OPS = frozenset({
+        "easgd_exchange", "easgd_get_center", "asgd_push_pull",
+        "asgd_set_lr", "asgd_get_center", "asgd_get_opt_state",
+        "gosgd_push", "gosgd_drain", "gosgd_deactivate",
+    })
 
 
 def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,

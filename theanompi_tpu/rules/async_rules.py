@@ -353,7 +353,15 @@ class ASGD(_AsyncRule):
                         if rank == 0:
                             # the server's optimizer applies the updates,
                             # so the schedule must reach IT (workers' own
-                            # opt_states are unused under ASGD)
+                            # opt_states are unused under ASGD).  Rank 0
+                            # forwards it when ITS epoch ends — other
+                            # workers may be mid-epoch, so a decay can
+                            # apply to their remaining pushes up to one
+                            # epoch early.  Deliberate: async pushes have
+                            # no global epoch anyway, the skew is bounded
+                            # by one epoch, and a step schedule is
+                            # insensitive to it (tested:
+                            # test_asgd_lr_schedule_reaches_server).
                             srv.set_lr(new_lr)
                             if ckpt is not None:
                                 ckpt.save(epoch, {

@@ -67,9 +67,7 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
                 model.train_iter(it, recorder)
                 profiler.step()  # trace spans epochs until n_steps hit
             model._flush_metrics(recorder)
-            recorder.start()
-            last_val = model.val_epoch(recorder)
-            recorder.end("calc")
+            last_val = model.val_epoch(recorder)  # times itself ('calc')
             model.adjust_hyperp(epoch + 1)
             if ckpt is not None:
                 ckpt.save(epoch, {"state": model.state, "epoch": epoch})

@@ -28,6 +28,31 @@ from typing import Any
 import numpy as np
 
 
+def device_fence(tree: Any) -> None:
+    """Reliable device fence for timing (VERDICT r1 #6).
+
+    ``jax.block_until_ready`` is the natural fence, but the
+    experimental axon TPU plugin's implementation can return before
+    the device has finished (bench.py discovered this in round 1 and
+    fenced with a value readback).  So: block first, then read small
+    leaves back outright (the hot-loop ``block_on`` is always the
+    step's scalar metrics — a few bytes) and one element of any large
+    leaf, which forces the host to wait for the producing program on
+    every backend.
+    """
+    import jax
+
+    leaves = [l for l in jax.tree.leaves(tree)
+              if isinstance(l, jax.Array)]
+    jax.block_until_ready(leaves)
+    for l in leaves:
+        if l.size <= 16:
+            np.asarray(l)
+        else:
+            shard = l.addressable_shards[0].data
+            np.asarray(shard.ravel()[:1])
+
+
 class Recorder:
     SECTIONS = ("calc", "comm", "wait", "load")
 
@@ -55,14 +80,14 @@ class Recorder:
     def end(self, section: str, block_on: Any = None) -> float:
         """Close the open section.  If ``block_on`` is a jax array (or
         pytree), block until it is ready first so device time is charged
-        to this section rather than to whoever touches the value next."""
+        to this section rather than to whoever touches the value next (via
+        ``device_fence`` — truthful on the axon plugin too)."""
         if section not in self.SECTIONS:
             raise ValueError(f"unknown section {section!r}")
         if self._t0 is None:
             raise RuntimeError("Recorder.end() without start()")
         if block_on is not None:
-            import jax
-            jax.block_until_ready(block_on)
+            device_fence(block_on)
         dt = time.monotonic() - self._t0
         self._t0 = None
         self.epoch_time[section] += dt

@@ -1,0 +1,110 @@
+"""Single-chip perf probe for the ResNet-50 BSP step (VERDICT r1 #2).
+
+Times the jitted train step under controlled variations (batch size,
+compute dtype, stem layout, metrics on/off) with a value-readback fence
+(the axon plugin's ``block_until_ready`` is unreliable — bench.py).
+Optionally dumps a ``jax.profiler`` trace for offline analysis.
+
+Usage:
+    python tools/perf_probe.py --batch 128 256 --steps 30
+    python tools/perf_probe.py --batch 256 --trace /tmp/trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ResNet-50 training cost: ~3x forward; forward ~4.09 GFLOP @ 224x224.
+TRAIN_GFLOP_PER_IMAGE = 12.3
+V5E_PEAK_TFLOPS = 197.0  # bf16
+
+
+def time_step(step, state, batch, rng, n_steps: int, warmup: int = 3):
+    for _ in range(warmup):
+        state, metrics = step(state, batch, rng)
+    jnp.asarray(metrics["loss"]).block_until_ready()
+    float(metrics["loss"])  # readback fence (axon block_until_ready lies)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch, rng)
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss)
+    return dt / n_steps, state
+
+
+def build(batch: int, dtype: str, variant: str):
+    from theanompi_tpu.models.base import ModelConfig
+    from theanompi_tpu.models.resnet50 import ResNet50
+    from theanompi_tpu.data.imagenet import ImageNet_data
+    from theanompi_tpu.parallel.mesh import data_mesh, shard_batch
+
+    devices = jax.devices()
+    mesh = data_mesh(len(devices), devices)
+    global_batch = batch * len(devices)
+
+    class ProbeResNet50(ResNet50):
+        def build_data(self):
+            return ImageNet_data(crop=224, synthetic_n=global_batch,
+                                 synthetic_pool=1, synthetic_store=32)
+
+    cfg = ModelConfig(batch_size=batch, compute_dtype=dtype,
+                      track_top5=False, print_freq=10**9)
+    model = ProbeResNet50(config=cfg, mesh=mesh, verbose=False)
+    if variant != "base":
+        raise ValueError(variant)
+    model.compile_iter_fns("avg")
+
+    x = np.random.default_rng(0).standard_normal(
+        (global_batch, 224, 224, 3)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 1000, global_batch)
+    staged = shard_batch((x, y), mesh)
+    return model, staged, mesh, global_batch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, nargs="+", default=[128])
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--variant", default="base")
+    ap.add_argument("--trace", default=None,
+                    help="dump a jax.profiler trace to this dir")
+    args = ap.parse_args()
+
+    for b in args.batch:
+        model, staged, mesh, global_batch = build(b, args.dtype, args.variant)
+        rng = jax.random.key(0)
+        step_s, state = time_step(model.train_step, model.state, staged, rng,
+                                  args.steps)
+        img_s = global_batch / step_s
+        per_chip = img_s / len(jax.devices())
+        tflops = per_chip * TRAIN_GFLOP_PER_IMAGE / 1000.0
+        print(json.dumps({
+            "batch_per_chip": b, "dtype": args.dtype, "variant": args.variant,
+            "step_ms": round(step_s * 1e3, 2),
+            "images_per_sec_per_chip": round(per_chip, 1),
+            "tflops_per_chip": round(tflops, 1),
+            "mfu_pct": round(100 * tflops / V5E_PEAK_TFLOPS, 1),
+        }))
+        if args.trace:
+            with jax.profiler.trace(args.trace):
+                for _ in range(5):
+                    state, metrics = model.train_step(state, staged,
+                                                      rng)
+                float(metrics["loss"])
+            print(f"trace written to {args.trace}")
+
+
+if __name__ == "__main__":
+    main()
