@@ -26,4 +26,4 @@ class StragglerTinyCifar(TinyCifar):
             import time
 
             time.sleep(self.straggler_sleep_s)
-        super().train_iter(count, recorder)
+        return super().train_iter(count, recorder)

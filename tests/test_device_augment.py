@@ -102,6 +102,34 @@ class TestImageNetDeviceAugment:
         assert x.dtype == np.float32 and x.shape == (32, 16, 16, 3)
 
 
+class TestCifarDeviceAugment:
+    def test_uint8_batches_and_pad_crop(self):
+        from theanompi_tpu.data.cifar10 import Cifar10_data
+
+        d = Cifar10_data(synthetic_n=256, augment_on_device=True)
+        assert d.device_transform is not None
+        x, y = next(iter(d.train_batches(0, 32)))
+        assert x.dtype == np.uint8 and x.shape == (32, 32, 32, 3)
+        out = d.device_transform(jnp.asarray(x), jax.random.key(0),
+                                 train=True)
+        assert out.shape == (32, 32, 32, 3) and out.dtype == jnp.float32
+
+    def test_eval_transform_matches_host_val(self):
+        """With pad=4 and crop=32, the eval center crop of the padded
+        image IS the original image — the device val path must equal
+        the host val path exactly."""
+        from theanompi_tpu.data.cifar10 import CIFAR_MEAN, CIFAR_STD, \
+            Cifar10_data
+
+        d_dev = Cifar10_data(synthetic_n=256, augment_on_device=True)
+        d_host = Cifar10_data(synthetic_n=256)
+        (x_dev, _), (x_host, _) = (next(iter(d.val_batches(32)))
+                                   for d in (d_dev, d_host))
+        got = np.asarray(d_dev.device_transform(jnp.asarray(x_dev), None,
+                                                train=False))
+        np.testing.assert_allclose(got, x_host, rtol=1e-6, atol=1e-6)
+
+
 class TestEndToEnd:
     def test_resnet_trains_on_device_augmented_batches(self, mesh8):
         """Full BSP step over the 8-device mesh with uint8 batches:

@@ -75,11 +75,21 @@ class Cifar10_data(Dataset):
     n_classes = 10
 
     def __init__(self, data_dir: str | None = None, synthetic_n: int = 4096,
-                 crop: int = 32, pad: int = 4, seed: int = 0):
+                 crop: int = 32, pad: int = 4, seed: int = 0,
+                 augment_on_device: bool = False):
         self.crop = crop
         self.pad = pad
         self.seed = seed
         self.synthetic = False
+        # device-side pad/crop/flip/normalize (ops/augment.py) — the
+        # host then only gathers uint8 rows; same economics as the
+        # ImageNet path (data/imagenet.py)
+        self.augment_on_device = augment_on_device
+        if augment_on_device:
+            from theanompi_tpu.ops.augment import make_device_augment
+
+            self.device_transform = make_device_augment(
+                crop, mean=self.mean, std=self.std, pad=pad)
 
         candidates = []
         if data_dir:
@@ -128,6 +138,9 @@ class Cifar10_data(Dataset):
         n = len(order) // global_batch
         for i in range(n):
             idx = order[i * global_batch:(i + 1) * global_batch]
+            if self.augment_on_device:
+                yield self.x_train[idx], self.y_train[idx]
+                continue
             x = augment_normalize(self.x_train[idx], self.crop, self.crop,
                                   aug_rng, pad=self.pad, mean=self.mean,
                                   std=self.std)
@@ -138,5 +151,8 @@ class Cifar10_data(Dataset):
         n = self.n_val_batches(global_batch)
         for i in range(n):
             sl = slice(i * global_batch, (i + 1) * global_batch)
+            if self.augment_on_device:
+                yield self.x_val[sl], self.y_val[sl]
+                continue
             yield center_normalize(self.x_val[sl], self.crop, self.crop,
                                    mean=self.mean, std=self.std), self.y_val[sl]

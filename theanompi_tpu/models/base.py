@@ -70,6 +70,18 @@ from theanompi_tpu.utils.recorder import Recorder
 PyTree = Any
 
 
+def _stack_host_batches(host_iter: Iterator, k: int) -> Iterator:
+    """Group k host batches into one stacked pytree with a leading
+    steps axis (the multi-step program's scan axis); drops a ragged
+    tail group."""
+    group = []
+    for batch in host_iter:
+        group.append(batch)
+        if len(group) == k:
+            yield jax.tree.map(lambda *xs: np.stack(xs), *group)
+            group = []
+
+
 @dataclasses.dataclass
 class ModelConfig:
     """One config dataclass per (model, rule) pair — SURVEY.md §5.6.
@@ -97,6 +109,10 @@ class ModelConfig:
     #: (the reference's loader semantics).  Honored by the ImageNet
     #: model family's build_data.
     augment_on_device: bool = True
+    #: scan this many training iterations into one device program
+    #: (parallel/bsp.py make_bsp_multi_step) — amortizes per-dispatch
+    #: tunnel overhead; 1 = one program per batch (reference cadence)
+    steps_per_call: int = 1
     seed: int = 42
     data_dir: str | None = None
     snapshot_dir: str = "./snapshots"
@@ -177,6 +193,7 @@ class TpuModel:
 
         self._rng = jax.random.key(self.config.seed + 1)
         self.train_step = None
+        self.train_step_multi = None
         self.eval_step = None
         self._train_prefetcher: DevicePrefetcher | None = None
         self._train_iter: Iterator | None = None
@@ -307,6 +324,12 @@ class TpuModel:
                                               self.mesh, exchanger,
                                               batch_partition=part,
                                               reduce_axes=axes)
+        if self.config.steps_per_call > 1:
+            from theanompi_tpu.parallel.bsp import make_bsp_multi_step
+
+            self.train_step_multi = make_bsp_multi_step(
+                self.loss_fn, self.tx, self.mesh, exchanger,
+                batch_partition=part, reduce_axes=axes)
         self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
                                             batch_partition=part,
                                             reduce_axes=axes)
@@ -327,7 +350,8 @@ class TpuModel:
         return jax.jit(gstep)
 
     def begin_epoch(self, epoch: int) -> int:
-        """Stage the epoch's prefetched train iterator; returns n_iters."""
+        """Stage the epoch's prefetched train iterator; returns n_iters
+        (rounded down to a multiple of ``steps_per_call``)."""
         self.cleanup_iter()
         self.current_epoch = epoch
         if self.multiprocess:
@@ -339,8 +363,19 @@ class TpuModel:
                 epoch, self.global_batch, self.shard_rank, self.shard_size)
             n_iters = self.data.n_train_batches_for(
                 epoch, self.global_batch, self.shard_rank, self.shard_size)
+        spec = self.batch_partition
+        k = self.config.steps_per_call
+        if k > 1:
+            host_iter = _stack_host_batches(host_iter, k)
+            n_iters -= n_iters % k
+            from jax.sharding import PartitionSpec as P
+
+            from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+            per_step = spec if spec is not None else P(AXIS_DATA)
+            spec = P(None, *per_step)  # leading steps axis is unsharded
         self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh,
-                                                  spec=self.batch_partition)
+                                                  spec=spec)
         self._train_iter = iter(self._train_prefetcher)
         return n_iters
 
@@ -348,9 +383,13 @@ class TpuModel:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def train_iter(self, count: int, recorder: Recorder) -> None:
+    def train_iter(self, count: int, recorder: Recorder) -> int:
+        """One training dispatch; returns the number of iterations it
+        covered (``steps_per_call`` when the scanned multi-step is on,
+        else 1) so epoch drivers can advance their counters."""
         if self.train_step is None:
             raise RuntimeError("call compile_iter_fns() first")
+        k = self.config.steps_per_call
         recorder.start()
         batch = next(self._train_iter)
         recorder.end("wait")  # time blocked on the loader = reference 'wait'
@@ -358,26 +397,39 @@ class TpuModel:
         # the annotation labels this iteration in jax.profiler traces
         # (utils/profiling.py); free when no trace is active
         with jax.profiler.StepTraceAnnotation("train", step_num=count):
-            self.state, metrics = self.train_step(self.state, batch,
-                                                  self._next_rng())
+            if k > 1:
+                self.state, metrics = self.train_step_multi(
+                    self.state, batch, self._next_rng())
+            else:
+                self.state, metrics = self.train_step(self.state, batch,
+                                                      self._next_rng())
         recorder.end("calc")  # async dispatch; device time lands on flush
         self._pending.append((count, metrics))
         # flush window: print_freq when printing, else a fixed window so
         # quiet runs (print_freq<=0) still batch device syncs
         window = recorder.print_freq if recorder.print_freq > 0 else 50
-        if len(self._pending) >= window:
+        if len(self._pending) * k >= window:
             self._flush_metrics(recorder)
             recorder.print_train_info(count)
+        return k
 
     def _flush_metrics(self, recorder: Recorder) -> None:
         """Convert pending device metrics (blocks until the device has
-        caught up — charged to 'calc')."""
+        caught up — charged to 'calc').  Multi-step entries carry
+        ``(k,)``-stacked metric leaves; each sub-step is recorded."""
         if not self._pending:
             return
         recorder.start()
         for _, m in self._pending:
-            recorder.train_metrics(float(m["loss"]), float(m["error"]),
-                                   self.global_batch)
+            loss = np.asarray(m["loss"])
+            err = np.asarray(m["error"])
+            if loss.ndim == 0:
+                recorder.train_metrics(float(loss), float(err),
+                                       self.global_batch)
+            else:
+                for l, e in zip(loss, err):
+                    recorder.train_metrics(float(l), float(e),
+                                           self.global_batch)
         recorder.end("calc", block_on=self._pending[-1][1])
         self._pending.clear()
         self.current_info = {

@@ -64,4 +64,5 @@ class Cifar10_model(TpuModel):
 
     def build_data(self):
         return Cifar10_data(data_dir=self.config.data_dir,
-                            seed=self.config.seed)
+                            seed=self.config.seed,
+                            augment_on_device=self.config.augment_on_device)

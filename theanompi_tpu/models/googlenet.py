@@ -89,36 +89,48 @@ class GoogLeNetCNN(nn.Module):
     n_classes: int = 1000
     aux_weight: float = 0.3
     dtype: jnp.dtype = jnp.float32
+    #: channel-width multiplier (1.0 = the paper's widths).  Tests
+    #: shrink the zoo with this instead of paying full-width CPU
+    #: compiles — the aux-head/LRN/inception structure is what the
+    #: contract tests care about, not the 1x widths.
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        def w(n: int) -> int:
+            return max(8, round(n * self.width_mult))
+
+        def inception(b1, b3r, b3, b5r, b5, bp):
+            return Inception(w(b1), w(b3r), w(b3), w(b5r), w(b5), w(bp),
+                             self.dtype)
+
         x = x.astype(self.dtype)
         # stem
-        x = ConvRelu(64, (7, 7), strides=(2, 2), dtype=self.dtype)(x)
+        x = ConvRelu(w(64), (7, 7), strides=(2, 2), dtype=self.dtype)(x)
         x = L.max_pool(x, 3, 2, padding="SAME")
         x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
-        x = ConvRelu(64, (1, 1), dtype=self.dtype)(x)
-        x = ConvRelu(192, (3, 3), dtype=self.dtype)(x)
+        x = ConvRelu(w(64), (1, 1), dtype=self.dtype)(x)
+        x = ConvRelu(w(192), (3, 3), dtype=self.dtype)(x)
         x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
         x = L.max_pool(x, 3, 2, padding="SAME")
         # inception 3a/3b
-        x = Inception(64, 96, 128, 16, 32, 32, self.dtype)(x)
-        x = Inception(128, 128, 192, 32, 96, 64, self.dtype)(x)
+        x = inception(64, 96, 128, 16, 32, 32)(x)
+        x = inception(128, 128, 192, 32, 96, 64)(x)
         x = L.max_pool(x, 3, 2, padding="SAME")
         # inception 4a..4e with aux heads off 4a and 4d
-        x = Inception(192, 96, 208, 16, 48, 64, self.dtype)(x)
+        x = inception(192, 96, 208, 16, 48, 64)(x)
         aux1 = (AuxHead(self.n_classes, self.dtype, name="aux1")(x, train)
                 if train else None)
-        x = Inception(160, 112, 224, 24, 64, 64, self.dtype)(x)
-        x = Inception(128, 128, 256, 24, 64, 64, self.dtype)(x)
-        x = Inception(112, 144, 288, 32, 64, 64, self.dtype)(x)
+        x = inception(160, 112, 224, 24, 64, 64)(x)
+        x = inception(128, 128, 256, 24, 64, 64)(x)
+        x = inception(112, 144, 288, 32, 64, 64)(x)
         aux2 = (AuxHead(self.n_classes, self.dtype, name="aux2")(x, train)
                 if train else None)
-        x = Inception(256, 160, 320, 32, 128, 128, self.dtype)(x)
+        x = inception(256, 160, 320, 32, 128, 128)(x)
         x = L.max_pool(x, 3, 2, padding="SAME")
         # inception 5a/5b
-        x = Inception(256, 160, 320, 32, 128, 128, self.dtype)(x)
-        x = Inception(384, 192, 384, 48, 128, 128, self.dtype)(x)
+        x = inception(256, 160, 320, 32, 128, 128)(x)
+        x = inception(384, 192, 384, 48, 128, 128)(x)
         # head
         x = L.global_avg_pool(x)
         x = L.Dropout(0.4)(x, train)

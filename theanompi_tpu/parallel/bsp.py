@@ -56,25 +56,15 @@ def _pmean(tree: PyTree, axes=(AXIS_DATA,)) -> PyTree:
     return jax.tree.map(lambda x: jax.lax.pmean(x, axes), tree)
 
 
-def make_bsp_train_step(
+def _make_shard_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
-    mesh: jax.sharding.Mesh,
-    exchanger: BSP_Exchanger | None = None,
-    donate: bool = True,
-    batch_partition: P = P(AXIS_DATA),
-    reduce_axes: tuple[str, ...] = (AXIS_DATA,),
+    exchanger: BSP_Exchanger | None,
+    reduce_axes: tuple[str, ...],
 ):
-    """Build the jitted SPMD training step.
-
-    Returns ``step(state, batch, rng) -> (state, metrics)`` where
-    ``state`` is replicated over the mesh, ``batch`` is a pytree whose
-    arrays are sharded by ``batch_partition`` (default: leading dim
-    over the ``data`` axis; a sequence-parallel step passes
-    ``P('data', 'seq')`` with ``reduce_axes=('data', 'seq')``), and
-    ``rng`` is a replicated key (folded per-shard inside for dropout
-    decorrelation).
-    """
+    """The per-shard training step body (one iteration): fwd + bwd +
+    exchange + update + cross-replica syncs.  Shared by the single-step
+    and the scanned multi-step builders."""
     exchanger = exchanger or BSP_Exchanger(
         axis=reduce_axes if len(reduce_axes) > 1 else reduce_axes[0])
 
@@ -121,10 +111,82 @@ def make_bsp_train_step(
             metrics,
         )
 
+    return shard_step
+
+
+def make_bsp_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: jax.sharding.Mesh,
+    exchanger: BSP_Exchanger | None = None,
+    donate: bool = True,
+    batch_partition: P = P(AXIS_DATA),
+    reduce_axes: tuple[str, ...] = (AXIS_DATA,),
+):
+    """Build the jitted SPMD training step.
+
+    Returns ``step(state, batch, rng) -> (state, metrics)`` where
+    ``state`` is replicated over the mesh, ``batch`` is a pytree whose
+    arrays are sharded by ``batch_partition`` (default: leading dim
+    over the ``data`` axis; a sequence-parallel step passes
+    ``P('data', 'seq')`` with ``reduce_axes=('data', 'seq')``), and
+    ``rng`` is a replicated key (folded per-shard inside for dropout
+    decorrelation).
+    """
+    shard_step = _make_shard_step(loss_fn, tx, exchanger, reduce_axes)
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
         in_specs=(P(), batch_partition, P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_bsp_multi_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: jax.sharding.Mesh,
+    exchanger: BSP_Exchanger | None = None,
+    donate: bool = True,
+    batch_partition: P = P(AXIS_DATA),
+    reduce_axes: tuple[str, ...] = (AXIS_DATA,),
+):
+    """``lax.scan`` several training iterations into ONE device program.
+
+    Returns ``multi_step(state, stacked_batch, rng) -> (state, metrics)``
+    where ``stacked_batch`` arrays carry a leading steps axis ``k`` (the
+    per-step batch axis behind it, sharded by ``batch_partition``) and
+    ``metrics`` leaves come back stacked ``(k,)``.
+
+    Why: each jitted execution through the axon tunnel pays a dispatch
+    round-trip; at ~50 ms steps that overhead is material, and one
+    program per k batches amortizes it k-fold.  Inside the scan each
+    sub-step is the SAME program as ``make_bsp_train_step`` builds —
+    grads psum-ed per sub-step, optimizer applied per sub-step — so the
+    training trajectory is identical to k separate calls with rngs
+    ``fold_in(rng, i)``.
+    """
+    single = _make_shard_step(loss_fn, tx, exchanger, reduce_axes)
+
+    def shard_multi(state: TrainState, stacked, rng):
+        def body(carry, xs):
+            i, batch = xs
+            new_state, metrics = single(carry, batch,
+                                        jax.random.fold_in(rng, i))
+            return new_state, metrics
+
+        k = jax.tree.leaves(stacked)[0].shape[0]
+        state, metrics = jax.lax.scan(
+            body, state, (jnp.arange(k), stacked))
+        return state, metrics
+
+    stacked_partition = P(None, *batch_partition)
+    sharded = jax.shard_map(
+        shard_multi,
+        mesh=mesh,
+        in_specs=(P(), stacked_partition, P()),
         out_specs=(P(), P()),
         check_vma=False,
     )

@@ -74,6 +74,12 @@ class _AsyncRule(Rule):
 
     def _build_workers(self, devs, modelfile, modelclass, config, **kwargs):
         cls = resolve_model_class(modelfile, modelclass)
+        cfg = config if config is not None else cls.default_config()
+        if getattr(cfg, "steps_per_call", 1) > 1:
+            raise ValueError(
+                "steps_per_call>1 (the scanned multi-step program) is a "
+                "BSP feature; the async rules exchange/gossip BETWEEN "
+                "iterations, which a fused k-step program would skip")
         models = []
         for i, dev in enumerate(devs):
             m = cls(config=config, mesh=data_mesh(1, [dev]),

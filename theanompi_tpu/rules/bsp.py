@@ -63,8 +63,21 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
     try:
         for epoch in range(start_epoch, n_epochs):
             n_iters = model.begin_epoch(epoch)
-            for it in range(n_iters):
-                model.train_iter(it, recorder)
+            it = 0
+            k = getattr(model.config, "steps_per_call", 1)
+            while it < n_iters:
+                # covers steps_per_call iterations per dispatch
+                consumed = model.train_iter(it, recorder)
+                if consumed is None:
+                    # legacy override that returns nothing — only valid
+                    # when each call consumes exactly one batch
+                    if k > 1:
+                        raise RuntimeError(
+                            f"{type(model).__name__}.train_iter returned "
+                            "None with steps_per_call>1; it must return "
+                            "the number of iterations consumed")
+                    consumed = 1
+                it += consumed
                 profiler.step()  # trace spans epochs until n_steps hit
             model._flush_metrics(recorder)
             last_val = model.val_epoch(recorder)  # times itself ('calc')
