@@ -66,6 +66,31 @@ def test_custom_modelfile_requires_class():
     assert _resolve_model(args) == ("my.custom.module", "MyModel")
 
 
+def test_parallel_degree_flags():
+    p = _build_parser(multihost=False)
+    args = p.parse_args(["BSP", "--model-parallel", "4",
+                         "--seq-parallel", "2"])
+    assert (args.model_parallel, args.seq_parallel) == (4, 2)
+    # async rules reject the BSP-only mesh flags
+    from theanompi_tpu.launcher import tmlocal
+
+    with pytest.raises(SystemExit, match="BSP options"):
+        tmlocal(["EASGD", "-m", "tests._tiny_models", "-c", "TinyCifar",
+                 "--model-parallel", "2"])
+
+
+def test_tmlocal_tp_end_to_end(tmp_path, capsys):
+    """tmlocal BSP --model-parallel: the TP model trains over a
+    (data x model) mesh built by the rule from CLI flags alone."""
+    from theanompi_tpu.launcher import tmlocal
+
+    rc = tmlocal(["BSP", "-m", "transformer_lm_tp", "-D", "8",
+                  "--model-parallel", "4", "--epochs", "1",
+                  "--batch-size", "64", "--snapshot-dir", str(tmp_path)])
+    assert rc == 0
+    assert "final val:" in capsys.readouterr().out
+
+
 def test_tmlocal_bsp_end_to_end(tmp_path, capsys):
     """The full CLI spine: tmlocal parses argv, applies config
     overrides, runs a 1-epoch BSP session on the CPU mesh and prints

@@ -1,0 +1,142 @@
+"""Tensor parallelism (parallel/tensor.py + TransformerLM_TP): params
+really shard over the ``model`` axis, the GSPMD step trains, and the
+(data x model) trajectory matches pure data parallelism."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.parallel.mesh import MeshSpec, make_training_mesh
+
+
+def lm_cfg(**kw):
+    base = dict(batch_size=4, n_epochs=1, learning_rate=0.1,
+                momentum=0.9, weight_decay=0.0, lr_schedule="constant",
+                print_freq=0)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def make_tp_lm(mesh, **net):
+    from theanompi_tpu.models.transformer import TransformerLM_TP
+
+    net.setdefault("vocab", 32)
+    net.setdefault("seq_len", 16)
+    net.setdefault("n_layers", 2)
+    net.setdefault("d_model", 32)
+    net.setdefault("n_heads", 4)
+    return TransformerLM_TP(config=lm_cfg(), mesh=mesh, verbose=False, **net)
+
+
+class TestSpecs:
+    def test_megatron_rules(self, devices8):
+        mesh = make_training_mesh(MeshSpec(data=2, model=4), devices8)
+        m = make_tp_lm(mesh)
+        specs = m.param_specs
+        blk = specs["Block_0"]
+        for col in ("q_proj", "k_proj", "v_proj", "mlp_up"):
+            assert blk[col]["kernel"] == P(None, "model"), col
+        for row in ("o_proj", "mlp_down"):
+            assert blk[row]["kernel"] == P("model", None), row
+        assert blk["mlp_up"]["bias"] == P("model")
+        assert blk["mlp_down"]["bias"] == P()
+        assert specs["Embed_0"]["embedding"] == P()
+        assert specs["pos_emb"] == P()
+
+    def test_params_physically_sharded(self, devices8):
+        mesh = make_training_mesh(MeshSpec(data=2, model=4), devices8)
+        m = make_tp_lm(mesh, d_model=32, n_heads=4)
+        q = m.state.params["Block_0"]["q_proj"]["kernel"]
+        assert q.shape == (32, 32)
+        # each model-shard holds out/4 columns, replicated over data
+        shard_shapes = {s.data.shape for s in q.addressable_shards}
+        assert shard_shapes == {(32, 8)}
+        # momentum buffers inherited the sharding (no replicated bloat):
+        # every mlp_up-kernel-shaped leaf in the optimizer state is
+        # sharded exactly like the parameter
+        up = m.state.params["Block_0"]["mlp_up"]["kernel"]
+        mom_leaves = [l for l in jax.tree.leaves(m.state.opt_state)
+                      if getattr(l, "shape", None) == up.shape]
+        assert mom_leaves, "no momentum buffer found for the mlp_up kernel"
+        for ml in mom_leaves:
+            assert {s.data.shape for s in ml.addressable_shards} == \
+                {s.data.shape for s in up.addressable_shards}
+
+
+class TestTraining:
+    def test_tp_trains_and_matches_dp(self, devices8, tmp_path):
+        """Same seed, same data: a (data=2, model=4) GSPMD TP run must
+        track the pure-DP (data=2) run on the shard_map spine —
+        identical math through a DIFFERENT code path (explicit psum
+        exchange vs compiler-inserted collectives), so a gradient-
+        reduction bug in either path breaks the match."""
+        from theanompi_tpu.rules.bsp import run_bsp_session
+        from theanompi_tpu.models.transformer import TransformerLM
+
+        net = dict(vocab=32, seq_len=16, n_layers=1, d_model=32, n_heads=4)
+
+        tp_mesh = make_training_mesh(MeshSpec(data=2, model=4), devices8)
+        tp = make_tp_lm(tp_mesh, **net)
+        res_tp = run_bsp_session(tp, checkpoint=False)
+
+        # pure-DP baseline: shard_map spine, seq axis of size 1 (ring
+        # attention over one shard = full attention), same global batch
+        dp_mesh = make_training_mesh(MeshSpec(data=2, seq=1),
+                                     devices8[:2])
+        dp = TransformerLM(config=lm_cfg(), mesh=dp_mesh, verbose=False,
+                           **net)
+        res_dp = run_bsp_session(dp, checkpoint=False)
+
+        assert np.isfinite(res_tp["val"]["loss"])
+        np.testing.assert_allclose(res_tp["val"]["loss"],
+                                   res_dp["val"]["loss"], rtol=2e-2)
+        # both learned the synthetic grammar about equally
+        assert res_tp["val"]["error"] < 0.9
+
+    def test_tp_multi_step_and_load_preserve_sharding(self, devices8,
+                                                      tmp_path):
+        """steps_per_call works on the TP path (scanned GSPMD program)
+        and the contract save/load round-trip keeps params sharded."""
+        from theanompi_tpu.models.transformer import TransformerLM_TP
+        from theanompi_tpu.utils.recorder import Recorder
+
+        mesh = make_training_mesh(MeshSpec(data=2, model=4), devices8)
+        m = TransformerLM_TP(config=lm_cfg(steps_per_call=2), mesh=mesh,
+                             verbose=False, vocab=32, seq_len=16,
+                             n_layers=1, d_model=32, n_heads=4)
+        m.compile_iter_fns()
+        rec = Recorder(rank=0, size=8, print_freq=0)
+        n = m.begin_epoch(0)
+        assert n % 2 == 0
+        assert m.train_iter(0, rec) == 2
+        m._flush_metrics(rec)
+        assert len(rec.train_losses) == 2  # one entry per sub-step
+        m.cleanup()
+
+        path = m.save(str(tmp_path / "tp_params.npz"))
+        before = {s.data.shape for s in
+                  m.state.params["Block_0"]["q_proj"]["kernel"]
+                  .addressable_shards}
+        m.load(path)
+        after = {s.data.shape for s in
+                 m.state.params["Block_0"]["q_proj"]["kernel"]
+                 .addressable_shards}
+        assert before == after == {(32, 8)}
+
+    def test_gspmd_step_decreases_loss(self, devices8):
+        mesh = make_training_mesh(MeshSpec(data=2, model=4), devices8)
+        m = make_tp_lm(mesh)
+        m.compile_iter_fns()
+        from theanompi_tpu.utils.recorder import Recorder
+
+        rec = Recorder(rank=0, size=8, print_freq=0)
+        n = m.begin_epoch(0)
+        first = last = None
+        for it in range(min(n, 20)):
+            m.train_iter(it, rec)
+        m._flush_metrics(rec)
+        first, last = rec.train_losses[0], rec.train_losses[-1]
+        assert np.isfinite(last) and last < first
+        m.cleanup()

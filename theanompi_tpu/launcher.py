@@ -48,6 +48,12 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="cap the number of epochs (for smoke runs)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--sync-type", default="avg", choices=("avg", "cdd"))
+    p.add_argument("--model-parallel", type=int, default=1,
+                   help="BSP: tensor-parallel degree (devices on the "
+                        "'model' mesh axis; use with transformer_lm_tp)")
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="BSP: sequence-parallel degree (devices on the "
+                        "'seq' axis; ring attention for transformer_lm)")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--snapshot-dir", default=None)
@@ -127,6 +133,12 @@ def _run(args, multihost: bool) -> int:
     kwargs = dict(devices=args.devices, modelfile=modelfile,
                   modelclass=modelclass, config=config, resume=args.resume,
                   sync_type=args.sync_type, max_epochs=args.epochs)
+    if args.rule == "BSP":
+        kwargs.update(model_parallel=args.model_parallel,
+                      seq_parallel=args.seq_parallel)
+    elif args.model_parallel > 1 or args.seq_parallel > 1:
+        raise SystemExit("--model-parallel/--seq-parallel are BSP options "
+                         "(async rules are data-parallel per worker)")
     if args.rule == "EASGD":
         kwargs.update(tau=args.tau, alpha=args.alpha)
     elif args.rule == "GOSGD":

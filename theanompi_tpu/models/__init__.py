@@ -14,6 +14,8 @@ MODEL_ZOO = {
     "wgan": ("theanompi_tpu.models.wasserstein_gan", "Wasserstein_GAN"),
     # beyond reference parity: long-context sequence-parallel LM
     "transformer_lm": ("theanompi_tpu.models.transformer", "TransformerLM"),
+    "transformer_lm_tp": ("theanompi_tpu.models.transformer",
+                          "TransformerLM_TP"),
     # zoo variants (reference lasagne_model_zoo equivalents)
     "vgg19": ("theanompi_tpu.models.model_zoo", "VGG19"),
     "resnet101": ("theanompi_tpu.models.model_zoo", "ResNet101"),

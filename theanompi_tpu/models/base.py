@@ -147,8 +147,16 @@ class TpuModel:
         model_state = variables  # e.g. {'batch_stats': ...} or {}
 
         self.tx = self._build_optimizer(self._base_lr)
-        state = TrainState.create(params, self.tx, model_state)
-        self.state = replicate(state, self.mesh)
+        self.state = self._create_state(params, model_state)
+
+    def _create_state(self, params, model_state) -> "TrainState":
+        """Build + place the initial training state.  Default: create
+        (optimizer init included) then replicate over the mesh — pure
+        DP.  Parameter-sharded models (TP) override so the optimizer
+        state is built directly from SHARDED params and never
+        materializes full-size on any device."""
+        return replicate(TrainState.create(params, self.tx, model_state),
+                         self.mesh)
 
     def _init_scaffold(self, config, mesh, verbose, shard_rank, shard_size,
                        data) -> None:

@@ -15,6 +15,7 @@ gradients exchanged over BOTH axes.
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -30,7 +31,14 @@ from theanompi_tpu.parallel.sequence import (
 
 
 class Block(nn.Module):
-    """Pre-LN transformer block with sequence-parallel attention."""
+    """Pre-LN transformer block with sequence-parallel attention.
+
+    Round-2 note: the attention projections are three named Dense
+    modules (``q_proj``/``k_proj``/``v_proj``), not one fused qkv —
+    required for clean tensor-parallel column sharding.  This changed
+    the param tree (old ``Dense_N`` snapshots no longer load) and the
+    per-projection xavier fan differs from the fused kernel's, so
+    pre-change training curves are not bit-reproducible."""
 
     d_model: int
     n_heads: int
@@ -43,11 +51,18 @@ class Block(nn.Module):
         b, t, _ = x.shape
         d_head = self.d_model // self.n_heads
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        qkv = nn.Dense(3 * self.d_model, use_bias=False,
-                       kernel_init=L.xavier_init(), dtype=self.dtype)(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # separate (named) Q/K/V projections: under tensor parallelism
+        # each is column-sharded over 'model' so every head's Q, K and
+        # V live on ONE shard — a fused qkv kernel sharded in
+        # contiguous chunks would straddle the split points and force
+        # an all-to-all per block (parallel/tensor.py rules)
+        proj = lambda name: nn.Dense(  # noqa: E731
+            self.d_model, use_bias=False, kernel_init=L.xavier_init(),
+            dtype=self.dtype, name=name)(h)
         shape = (b, t, self.n_heads, d_head)
-        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        q = proj("q_proj").reshape(shape)
+        k = proj("k_proj").reshape(shape)
+        v = proj("v_proj").reshape(shape)
         if seq_axis is not None:
             o = sequence_attention(q, k, v, axis_name=seq_axis, causal=True,
                                    strategy=self.sp_strategy)
@@ -55,12 +70,14 @@ class Block(nn.Module):
             o = attention_reference(q, k, v, causal=True)
         o = o.reshape((b, t, self.d_model))
         x = x + nn.Dense(self.d_model, use_bias=False,
-                         kernel_init=L.xavier_init(), dtype=self.dtype)(o)
+                         kernel_init=L.xavier_init(), dtype=self.dtype,
+                         name="o_proj")(o)
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.d_ff, kernel_init=L.he_init(), dtype=self.dtype)(h)
+        h = nn.Dense(self.d_ff, kernel_init=L.he_init(), dtype=self.dtype,
+                     name="mlp_up")(h)
         h = nn.gelu(h)
         x = x + nn.Dense(self.d_model, kernel_init=L.xavier_init(),
-                         dtype=self.dtype)(h)
+                         dtype=self.dtype, name="mlp_down")(h)
         return x
 
 
@@ -109,6 +126,9 @@ class TransformerLM(TpuModel):
     name = "transformer_lm"
     sp_strategy = "ring"
     batch_partition = P(AXIS_DATA, AXIS_SEQ)   # (B, T) over (data, seq)
+    #: mesh axis the TIME dimension is sharded over inside the step
+    #: (None = full attention; the TP variant sets None)
+    seq_axis: str | None = AXIS_SEQ
 
     @classmethod
     def default_config(cls) -> ModelConfig:
@@ -150,7 +170,8 @@ class TransformerLM(TpuModel):
     def loss_fn(self, params, model_state, batch, rng):
         tokens, targets = batch
         logits = self.module.apply({"params": params}, tokens, train=True,
-                                   seq_axis=AXIS_SEQ, rngs={"dropout": rng})
+                                   seq_axis=self.seq_axis,
+                                   rngs={"dropout": rng})
         v = logits.shape[-1]
         loss = L.softmax_cross_entropy(logits.reshape(-1, v),
                                        targets.reshape(-1))
@@ -160,10 +181,80 @@ class TransformerLM(TpuModel):
     def eval_fn(self, params, model_state, batch):
         tokens, targets = batch
         logits = self.module.apply({"params": params}, tokens, train=False,
-                                   seq_axis=AXIS_SEQ)
+                                   seq_axis=self.seq_axis)
         v = logits.shape[-1]
         return {"loss": L.softmax_cross_entropy(logits.reshape(-1, v),
                                                 targets.reshape(-1)),
                 "error": L.error_rate(logits.reshape(-1, v),
                                       targets.reshape(-1))}
+
+
+class TransformerLM_TP(TransformerLM):
+    """Tensor-parallel LM over a (data x model) mesh.
+
+    Megatron-style TP the GSPMD way (parallel/tensor.py): block
+    weights are sharded over ``model`` (Q/K/V/MLP-up column-wise,
+    attn-out/MLP-down row-wise), the step is ONE plain jit and the
+    compiler inserts every collective — both the TP all-reduces and
+    the data-axis gradient all-reduce.  Attention runs unsharded in
+    time (``seq_axis=None``); heads are what ``model`` splits, so this
+    composes with DP, not SP.
+    """
+
+    name = "transformer_lm_tp"
+    batch_partition = P(AXIS_DATA)   # tokens (B, T): batch over 'data'
+    seq_axis = None                  # full attention; 'model' splits heads
+
+    def _create_state(self, params, model_state):
+        """Shard params per the Megatron specs and build the optimizer
+        state FROM the sharded tree — full-size momentum buffers never
+        exist on any device."""
+        from theanompi_tpu.parallel.tensor import (
+            shard_train_state,
+            transformer_tp_specs,
+        )
+
+        self.param_specs = transformer_tp_specs(params)
+        return shard_train_state(params, model_state, self.mesh,
+                                 self.param_specs, self.tx)
+
+    def load(self, path: str) -> None:
+        """Contract ``load`` that PRESERVES the TP sharding (the base
+        implementation would re-replicate params while the optimizer
+        state stays sharded).  The template is shape/dtype-only — no
+        cross-device gather of the sharded weights."""
+        from theanompi_tpu.utils.helper_funcs import load_params_npz
+        from jax.sharding import NamedSharding
+
+        template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self.state.params)
+        params = load_params_npz(path, template)
+        sharded = jax.tree.map(
+            lambda x, spec: jax.device_put(
+                jnp.asarray(x), NamedSharding(self.mesh, spec)),
+            params, self.param_specs)
+        self.state = self.state.replace(params=sharded)
+
+    def compile_iter_fns(self, sync_type: str = "avg") -> None:
+        """TP path: plain jit, shardings from the committed arrays.
+        The global-batch mean gradient IS the averaged (``avg``)
+        exchange; ``cdd`` (the reference's summed exchange, used with a
+        pre-scaled LR) is realized by scaling grads by the data-axis
+        size."""
+        from theanompi_tpu.parallel.mesh import data_axis_size
+        from theanompi_tpu.parallel.tensor import (
+            make_gspmd_eval_step,
+            make_gspmd_multi_step,
+            make_gspmd_train_step,
+        )
+
+        scale = float(data_axis_size(self.mesh)) if sync_type == "cdd" \
+            else 1.0
+        self.train_step = make_gspmd_train_step(self.loss_fn, self.tx,
+                                                grad_scale=scale)
+        if self.config.steps_per_call > 1:
+            self.train_step_multi = make_gspmd_multi_step(
+                self.loss_fn, self.tx, grad_scale=scale)
+        self.eval_step = make_gspmd_eval_step(self.eval_fn)
 

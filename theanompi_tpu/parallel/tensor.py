@@ -1,0 +1,169 @@
+"""Tensor parallelism over the mesh's ``model`` axis (GSPMD).
+
+Beyond reference parity (the reference is data-parallel only,
+SURVEY.md §2.11) — this is the framework's Megatron-style TP path for
+the transformer family, built the idiomatic XLA way: annotate the
+parameter shardings, let the compiler insert the collectives (the
+scaling-book recipe).  Two deliberate styles coexist:
+
+* **explicit SPMD (shard_map)** where the algorithm needs manual
+  control — ring attention over ``seq``, psum gradient exchange over
+  ``data`` (parallel/bsp.py, parallel/sequence.py);
+* **automatic GSPMD (jit + NamedSharding)** where XLA partitions
+  matmuls better than hand-written collectives — TP: QKV/MLP-in
+  kernels column-sharded ``P(None, 'model')``, attn-out/MLP-out
+  row-sharded ``P('model', None)``, the all-reduce after each pair
+  inserted by the compiler.
+
+Data parallelism composes for free: the batch is sharded over
+``data``, parameters are replicated over ``data`` and sharded over
+``model``, and the gradient all-reduce over ``data`` is likewise
+compiler-inserted — one jit, a (data x model) mesh, no axis names in
+the model code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from theanompi_tpu.parallel.bsp import TrainState
+from theanompi_tpu.parallel.mesh import AXIS_MODEL
+
+PyTree = Any
+
+
+def transformer_tp_specs(params: PyTree) -> PyTree:
+    """Megatron sharding rules for ``TransformerLMNet`` parameters.
+
+    Per block: ``q_proj``/``k_proj``/``v_proj`` and ``mlp_up`` are
+    column-parallel — output dim over ``model``, so each head's Q, K
+    and V land on one shard (requires ``n_heads % tp == 0``);
+    ``o_proj`` and ``mlp_down`` are row-parallel — input dim over
+    ``model``, their products all-reduced by the compiler.  Embeddings,
+    norms, positional table and the LM head stay replicated (small
+    next to the block weights).
+    """
+    col = {"q_proj", "k_proj", "v_proj", "mlp_up"}
+    row = {"o_proj", "mlp_down"}
+
+    def rule(path, leaf) -> P:
+        keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+        in_block = any(isinstance(k, str) and k.startswith("Block_")
+                       for k in keys)
+        if not in_block or leaf.ndim == 0:
+            return P()
+        dense = next((k for k in keys if k in col | row), None)
+        if dense in col:
+            # kernel (in, out) -> out sharded; bias (out,) -> sharded
+            return P(None, AXIS_MODEL) if leaf.ndim == 2 else P(AXIS_MODEL)
+        if dense in row:
+            # kernel (in, out) -> in sharded; bias stays replicated
+            # (added after the all-reduced product)
+            return P(AXIS_MODEL, None) if leaf.ndim == 2 else P()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def shard_train_state(params: PyTree, model_state: PyTree, mesh: Mesh,
+                      param_specs: PyTree,
+                      tx: optax.GradientTransformation) -> TrainState:
+    """Build a TrainState with params placed per their TP specs and the
+    optimizer state created FROM the sharded params — the full-size
+    momentum buffers are never materialized on any single device
+    (``zeros_like`` of a sharded array inherits its sharding; the
+    explicit re-put per spec is belt and braces)."""
+    params = jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, param_specs)
+    opt_state = optax.tree_map_params(
+        tx,
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        tx.init(params),
+        param_specs,
+    )
+    rep = NamedSharding(mesh, P())
+    import jax.numpy as jnp
+
+    return TrainState(
+        step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+        params=params,
+        opt_state=opt_state,
+        model_state=jax.tree.map(lambda x: jax.device_put(x, rep),
+                                 {} if model_state is None else model_state),
+    )
+
+
+def _gspmd_step(loss_fn: Callable, tx: optax.GradientTransformation,
+                grad_scale: float = 1.0):
+    """The shared one-iteration step body for the GSPMD builders.
+    ``grad_scale`` realizes the reference's sum-mode (``cdd``) exchange:
+    the global-batch mean gradient times the data-axis size equals the
+    sum of per-worker mean gradients."""
+
+    def step(state: TrainState, batch, rng):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (new_ms, metrics)), grads = grad_fn(
+            state.params, state.model_state, batch, rng)
+        metrics = dict(metrics)
+        metrics.setdefault("loss", loss)
+        if grad_scale != 1.0:
+            grads = jax.tree.map(lambda g: g * grad_scale, grads)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(step=state.step + 1, params=new_params,
+                          opt_state=new_opt, model_state=new_ms), metrics
+
+    return step
+
+
+def make_gspmd_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    donate: bool = True,
+    grad_scale: float = 1.0,
+):
+    """One jitted training step with NO manual collectives: shardings
+    flow in from the committed state/batch arrays and GSPMD inserts the
+    TP all-reduces (row-parallel products) and the DP gradient
+    all-reduce.  ``loss_fn(params, model_state, batch, rng)`` computes
+    the GLOBAL-batch mean loss (the batch is one logical array here,
+    unlike the per-shard view inside shard_map)."""
+    step = _gspmd_step(loss_fn, tx, grad_scale)
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_gspmd_multi_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    donate: bool = True,
+    grad_scale: float = 1.0,
+):
+    """``lax.scan`` of k GSPMD steps in one program (the TP analogue of
+    ``parallel/bsp.make_bsp_multi_step``): ``stacked_batch`` carries a
+    leading steps axis, rngs are ``fold_in(rng, i)`` per sub-step,
+    metrics come back stacked ``(k,)``."""
+    import jax.numpy as jnp
+
+    step = _gspmd_step(loss_fn, tx, grad_scale)
+
+    def multi(state: TrainState, stacked, rng):
+        def body(carry, xs):
+            i, batch = xs
+            return step(carry, batch, jax.random.fold_in(rng, i))
+
+        k = jax.tree.leaves(stacked)[0].shape[0]
+        return jax.lax.scan(body, state, (jnp.arange(k), stacked))
+
+    return jax.jit(multi, donate_argnums=(0,) if donate else ())
+
+
+def make_gspmd_eval_step(eval_fn: Callable):
+    def step(state: TrainState, batch):
+        return eval_fn(state.params, state.model_state, batch)
+
+    return jax.jit(step)

@@ -96,14 +96,30 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
 
 
 class BSP(Rule):
-    """Synchronous BSP data-parallel rule (reference rule #1)."""
+    """Synchronous BSP data-parallel rule (reference rule #1).
+
+    ``model_parallel``/``seq_parallel`` carve those axes out of the
+    device set (remaining devices go to ``data``) so tensor-parallel
+    models (``transformer_lm_tp``) and sequence-parallel runs are
+    reachable from the launcher, not just from Python."""
 
     name = "BSP"
     uses_global_mesh = True
 
     def _session(self, devs, modelfile, modelclass, config, resume,
-                 sync_type, max_epochs=None, checkpoint=True, **kwargs):
-        mesh = data_mesh(len(devs), devs)
+                 sync_type, max_epochs=None, checkpoint=True,
+                 model_parallel: int = 1, seq_parallel: int = 1, **kwargs):
+        if model_parallel > 1 or seq_parallel > 1:
+            from theanompi_tpu.parallel.mesh import (
+                MeshSpec,
+                make_training_mesh,
+            )
+
+            mesh = make_training_mesh(
+                MeshSpec(data=-1, model=model_parallel, seq=seq_parallel),
+                devs)
+        else:
+            mesh = data_mesh(len(devs), devs)
         cls = resolve_model_class(modelfile, modelclass)
         self.model = cls(config=config, mesh=mesh, **kwargs)
         self.result = run_bsp_session(self.model, sync_type=sync_type,
