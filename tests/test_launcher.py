@@ -79,6 +79,7 @@ def test_parallel_degree_flags():
                  "--model-parallel", "2"])
 
 
+@pytest.mark.slow
 def test_tmlocal_tp_end_to_end(tmp_path, capsys):
     """tmlocal BSP --model-parallel: the TP model trains over a
     (data x model) mesh built by the rule from CLI flags alone."""

@@ -112,6 +112,7 @@ class TestGoogLeNet:
         assert "aux1" in m.state.params and "aux2" in m.state.params
         run_short_training(m)
 
+    @pytest.mark.slow
     def test_eval_path_skips_aux(self, mesh8):
         import jax.numpy as jnp
         m = self.make(mesh8)
@@ -135,14 +136,17 @@ class TestZooVariants:
         from theanompi_tpu.models.model_zoo import VGG19_BLOCKS
         assert sum(n for n, _ in VGG19_BLOCKS) == 16  # conf. E: 16 convs
 
-    def test_resnet_variant_depths(self, mesh8):
-        import jax
+    def test_resnet_variant_depths(self):
         from theanompi_tpu.models.model_zoo import ResNet101, ResNet152
-        from theanompi_tpu.models.resnet50 import ResNet
 
         # depth = 3*sum(stages)+2 (bottleneck) — 101 and 152
         assert 3 * sum(ResNet101.stage_sizes) + 2 == 101
         assert 3 * sum(ResNet152.stage_sizes) + 2 == 152
+
+    @pytest.mark.slow
+    def test_resnet_variant_trains(self, mesh8):
+        from theanompi_tpu.models.model_zoo import ResNet101
+        from theanompi_tpu.models.resnet50 import ResNet
 
         class TinyR101(ResNet101):
             def build_data(self):

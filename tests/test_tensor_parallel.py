@@ -66,6 +66,7 @@ class TestSpecs:
 
 
 class TestTraining:
+    @pytest.mark.slow
     def test_tp_trains_and_matches_dp(self, devices8, tmp_path):
         """Same seed, same data: a (data=2, model=4) GSPMD TP run must
         track the pure-DP (data=2) run on the shard_map spine —
@@ -124,6 +125,32 @@ class TestTraining:
                  m.state.params["Block_0"]["q_proj"]["kernel"]
                  .addressable_shards}
         assert before == after == {(32, 8)}
+
+    def test_tp_rejects_indivisible_heads(self, devices8):
+        mesh = make_training_mesh(MeshSpec(data=1, model=8), devices8)
+        with pytest.raises(ValueError, match="divide n_heads"):
+            make_tp_lm(mesh, n_heads=4)  # 4 heads over model=8
+
+    def test_orbax_resume_preserves_tp_sharding(self, devices8, tmp_path):
+        """VERIFY the resume path re-shards: a checkpointed TP session
+        resumed via run_bsp_session must come back with model-sharded
+        params, not replicated restored arrays."""
+        from theanompi_tpu.rules.bsp import run_bsp_session
+
+        mesh = make_training_mesh(MeshSpec(data=2, model=4), devices8)
+        cfg = lm_cfg(n_epochs=1, snapshot_dir=str(tmp_path))
+        from theanompi_tpu.models.transformer import TransformerLM_TP
+
+        net = dict(vocab=32, seq_len=16, n_layers=1, d_model=32, n_heads=4)
+        m = TransformerLM_TP(config=cfg, mesh=mesh, verbose=False, **net)
+        run_bsp_session(m, checkpoint=True)
+
+        cfg2 = lm_cfg(n_epochs=2, snapshot_dir=str(tmp_path))
+        m2 = TransformerLM_TP(config=cfg2, mesh=mesh, verbose=False, **net)
+        res = run_bsp_session(m2, resume=True, checkpoint=True)
+        assert res["epochs_run"] == 1  # resumed at epoch 1 of 2
+        q = m2.state.params["Block_0"]["q_proj"]["kernel"]
+        assert {s.data.shape for s in q.addressable_shards} == {(32, 8)}
 
     def test_gspmd_step_decreases_loss(self, devices8):
         mesh = make_training_mesh(MeshSpec(data=2, model=4), devices8)

@@ -54,6 +54,9 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="BSP: sequence-parallel degree (devices on the "
                         "'seq' axis; ring attention for transformer_lm)")
+    p.add_argument("--pipe-parallel", type=int, default=1,
+                   help="BSP: pipeline-parallel degree (devices on the "
+                        "'pipe' axis; use with transformer_lm_pp)")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--snapshot-dir", default=None)
@@ -135,10 +138,13 @@ def _run(args, multihost: bool) -> int:
                   sync_type=args.sync_type, max_epochs=args.epochs)
     if args.rule == "BSP":
         kwargs.update(model_parallel=args.model_parallel,
-                      seq_parallel=args.seq_parallel)
-    elif args.model_parallel > 1 or args.seq_parallel > 1:
-        raise SystemExit("--model-parallel/--seq-parallel are BSP options "
-                         "(async rules are data-parallel per worker)")
+                      seq_parallel=args.seq_parallel,
+                      pipe_parallel=args.pipe_parallel)
+    elif (args.model_parallel > 1 or args.seq_parallel > 1
+          or args.pipe_parallel > 1):
+        raise SystemExit("--model-parallel/--seq-parallel/--pipe-parallel "
+                         "are BSP options (async rules are data-parallel "
+                         "per worker)")
     if args.rule == "EASGD":
         kwargs.update(tau=args.tau, alpha=args.alpha)
     elif args.rule == "GOSGD":

@@ -16,6 +16,8 @@ MODEL_ZOO = {
     "transformer_lm": ("theanompi_tpu.models.transformer", "TransformerLM"),
     "transformer_lm_tp": ("theanompi_tpu.models.transformer",
                           "TransformerLM_TP"),
+    "transformer_lm_pp": ("theanompi_tpu.models.transformer",
+                          "TransformerLM_PP"),
     # zoo variants (reference lasagne_model_zoo equivalents)
     "vgg19": ("theanompi_tpu.models.model_zoo", "VGG19"),
     "resnet101": ("theanompi_tpu.models.model_zoo", "ResNet101"),

@@ -158,6 +158,14 @@ class TpuModel:
         return replicate(TrainState.create(params, self.tx, model_state),
                          self.mesh)
 
+    def adopt_restored_state(self, state: "TrainState") -> "TrainState":
+        """Hook for checkpoint resume: re-establish this model's device
+        placement on a restored (host-side) state.  Default: as-is —
+        the shard_map step's in_specs place replicated state on entry.
+        Parameter-sharded models (TP: plain jit, shardings inferred
+        from committed arrays) override to re-place per their specs."""
+        return state
+
     def _init_scaffold(self, config, mesh, verbose, shard_rank, shard_size,
                        data) -> None:
         """The contract scaffolding shared by every model — including

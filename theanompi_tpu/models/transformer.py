@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 from theanompi_tpu.data.lm import SeqLM_data
 from theanompi_tpu.models import layers as L
 from theanompi_tpu.models.base import ModelConfig, TpuModel
-from theanompi_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
+from theanompi_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE, AXIS_SEQ
 from theanompi_tpu.parallel.sequence import (
     attention_reference,
     sequence_attention,
@@ -209,14 +209,42 @@ class TransformerLM_TP(TransformerLM):
         """Shard params per the Megatron specs and build the optimizer
         state FROM the sharded tree — full-size momentum buffers never
         exist on any device."""
+        from theanompi_tpu.parallel.mesh import AXIS_MODEL
         from theanompi_tpu.parallel.tensor import (
             shard_train_state,
             transformer_tp_specs,
         )
 
+        tp = self.mesh.shape[AXIS_MODEL]
+        c = self._net_cfg
+        d_ff = 4 * c["d_model"]
+        if c["n_heads"] % tp or d_ff % tp:
+            raise ValueError(
+                f"tensor parallelism {tp} must divide n_heads="
+                f"{c['n_heads']} and d_ff={d_ff}: otherwise heads/hidden "
+                "straddle shards and GSPMD silently inserts per-block "
+                "reshards instead of the Megatron pattern")
         self.param_specs = transformer_tp_specs(params)
         return shard_train_state(params, model_state, self.mesh,
                                  self.param_specs, self.tx)
+
+    def adopt_restored_state(self, state):
+        """Checkpoint resume: re-place restored host arrays per the TP
+        specs (the step is a plain jit whose shardings come from the
+        committed arrays — without this, a resumed model trains fully
+        replicated, defeating TP)."""
+        import optax
+        from jax.sharding import NamedSharding
+
+        def put(leaf, spec):
+            return jax.device_put(jnp.asarray(leaf),
+                                  NamedSharding(self.mesh, spec))
+
+        return state.replace(
+            params=jax.tree.map(put, state.params, self.param_specs),
+            opt_state=optax.tree_map_params(
+                self.tx, put, state.opt_state, self.param_specs),
+        )
 
     def load(self, path: str) -> None:
         """Contract ``load`` that PRESERVES the TP sharding (the base
@@ -258,3 +286,189 @@ class TransformerLM_TP(TransformerLM):
                 self.loss_fn, self.tx, grad_scale=scale)
         self.eval_step = make_gspmd_eval_step(self.eval_fn)
 
+
+
+class TransformerLM_PP(TpuModel):
+    """Pipeline-parallel LM over a (data x pipe) mesh (GPipe-style).
+
+    The blocks live STACKED on a leading layer axis sharded
+    ``P('pipe')`` — each stage owns ``n_layers / pipe`` blocks — and
+    microbatches flow stage-to-stage via ``ppermute`` inside the
+    jitted step (parallel/pipeline.py); jax transposes the schedule
+    for the backward pass.  Embedding/positional tables are replicated
+    and their gradients psum-ed over ``pipe`` (only stage 0's compute
+    path touches them); the final norm + LM head run identically on
+    every stage from the broadcast pipeline output.
+
+    Like the WGAN, this model diverges from the single-flax-module
+    TrainState path, so it assembles its pieces on the shared
+    ``_init_scaffold`` (models/base.py).
+    """
+
+    name = "transformer_lm_pp"
+    batch_partition = P(AXIS_DATA)
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return TransformerLM.default_config()
+
+    def __init__(self, config: ModelConfig | None = None, mesh=None,
+                 verbose: bool = True, shard_rank: int = 0,
+                 shard_size: int = 1, data=None, vocab: int = 256,
+                 seq_len: int = 128, n_layers: int = 4, d_model: int = 128,
+                 n_heads: int = 4, n_microbatches: int = 4):
+        self._net_cfg = dict(vocab=vocab, seq_len=seq_len,
+                             n_layers=n_layers, d_model=d_model,
+                             n_heads=n_heads)
+        self.n_microbatches = n_microbatches
+        self._init_scaffold(config, mesh, verbose, shard_rank, shard_size,
+                            data)
+        n_stages = self.mesh.shape[AXIS_PIPE]
+        if n_layers % n_stages != 0:
+            raise ValueError(f"n_layers={n_layers} not divisible by "
+                             f"pipe={n_stages} stages")
+        local_batch = self.global_batch // self.mesh.shape[AXIS_DATA]
+        if local_batch % n_microbatches != 0:
+            raise ValueError(
+                f"per-data-shard batch {local_batch} not divisible by "
+                f"{n_microbatches} microbatches")
+
+        from theanompi_tpu.parallel.pipeline import stack_stages
+        from theanompi_tpu.parallel.tensor import shard_train_state
+
+        dtype = self._compute_dtype()
+        d = d_model
+        self.embed_mod = nn.Embed(vocab, d,
+                                  embedding_init=L.gaussian_init(0.02))
+        self.block_mod = Block(d, n_heads, 4 * d, dtype=dtype)
+        self.ln_mod = nn.LayerNorm(dtype=dtype)
+        self.head_mod = nn.Dense(vocab, kernel_init=L.xavier_init(),
+                                 dtype=dtype)
+
+        rng = jax.random.key(self.config.seed)
+        tok = jnp.zeros((2, seq_len), jnp.int32)
+        x = jnp.zeros((2, seq_len, d), jnp.float32)
+        params = {
+            "embed": self.embed_mod.init(rng, tok)["params"],
+            "pos_emb": L.gaussian_init(0.02)(
+                jax.random.fold_in(rng, 1), (seq_len, d)),
+            "blocks": stack_stages([
+                self.block_mod.init(jax.random.fold_in(rng, 10 + i),
+                                    x)["params"]
+                for i in range(n_layers)]),
+            "ln_f": self.ln_mod.init(rng, x)["params"],
+            "head": self.head_mod.init(jax.random.fold_in(rng, 2),
+                                       x)["params"],
+        }
+        self.tx = self._build_optimizer(self._base_lr)
+        self.param_specs = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: (P(AXIS_PIPE)
+                                if getattr(path[0], "key", None) == "blocks"
+                                else P()),
+            params)
+        # stage params sharded over 'pipe' from the start; optimizer
+        # state built from the sharded tree (parallel/tensor.py)
+        self.state = shard_train_state(params, {}, self.mesh,
+                                       self.param_specs, self.tx)
+        # masked-loss convention: every param NOT owned per-stage has
+        # real grads on exactly one stage (embeddings on stage 0 via
+        # the inject path, head/ln_f on the last via the masked loss)
+        # and zeros elsewhere -> psum over 'pipe' syncs the replicas
+        self.pipe_psum_mask = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: getattr(path[0], "key", None) != "blocks",
+            params)
+
+    def _input_dtype(self):
+        return jnp.int32
+
+    def build_data(self):
+        c = self._net_cfg
+        return SeqLM_data(vocab=c["vocab"], seq_len=c["seq_len"],
+                          seed=self.config.seed)
+
+    # -- forward through the pipeline (runs inside shard_map) ---------------
+
+    def _forward(self, params, tokens):
+        from theanompi_tpu.parallel.pipeline import pipeline_apply
+
+        b, t = tokens.shape
+        d = self._net_cfg["d_model"]
+        x = self.embed_mod.apply({"params": params["embed"]}, tokens)
+        x = x + params["pos_emb"][None, :t]
+        x = x.astype(self._compute_dtype())
+        m = self.n_microbatches
+        xm = x.reshape(m, b // m, t, d)
+
+        def stage_fn(stage_params, h):
+            def body(carry, layer_params):
+                out = self.block_mod.apply({"params": layer_params}, carry,
+                                           seq_axis=None)
+                return out, None
+
+            h, _ = lax.scan(body, h, stage_params)
+            return h
+
+        outs = pipeline_apply(stage_fn, params["blocks"], xm,
+                              axis_name=AXIS_PIPE)
+        h = outs.reshape(b, t, d)
+        h = self.ln_mod.apply({"params": params["ln_f"]}, h)
+        logits = self.head_mod.apply({"params": params["head"]}, h)
+        return logits.astype(jnp.float32)
+
+    def loss_fn(self, params, model_state, batch, rng):
+        from theanompi_tpu.parallel.pipeline import last_stage_mask
+
+        del rng  # no dropout in the block
+        tokens, targets = batch
+        logits = self._forward(params, tokens)
+        v = logits.shape[-1]
+        # masked-loss convention (parallel/pipeline.py): seed the
+        # backward on the last stage only; the step psums metrics and
+        # the single-stage params' grads over 'pipe'
+        mask = last_stage_mask()
+        loss = mask * L.softmax_cross_entropy(logits.reshape(-1, v),
+                                              targets.reshape(-1))
+        err = mask * L.error_rate(logits.reshape(-1, v),
+                                  targets.reshape(-1))
+        return loss, (model_state, {"loss": loss, "error": err})
+
+    def eval_fn(self, params, model_state, batch):
+        from theanompi_tpu.parallel.pipeline import last_stage_mask
+
+        tokens, targets = batch
+        logits = self._forward(params, tokens)
+        v = logits.shape[-1]
+        mask = last_stage_mask()
+        return {"loss": mask * L.softmax_cross_entropy(
+                    logits.reshape(-1, v), targets.reshape(-1)),
+                "error": mask * L.error_rate(logits.reshape(-1, v),
+                                             targets.reshape(-1))}
+
+    def compile_iter_fns(self, sync_type: str = "avg") -> None:
+        from theanompi_tpu.parallel.bsp import TrainState
+        from theanompi_tpu.parallel.mesh import data_axis_size
+        from theanompi_tpu.parallel.pipeline import (
+            make_pp_eval_step,
+            make_pp_train_step,
+            opt_state_specs,
+        )
+
+        if self.config.steps_per_call > 1:
+            raise ValueError("steps_per_call>1 is not implemented for the "
+                             "pipeline-parallel path")
+        state_specs = TrainState(
+            step=P(),
+            params=self.param_specs,
+            opt_state=opt_state_specs(self.tx, self.state.opt_state,
+                                      self.param_specs),
+            model_state={},
+        )
+        scale = float(data_axis_size(self.mesh)) if sync_type == "cdd" \
+            else 1.0
+        self.train_step = make_pp_train_step(
+            self.loss_fn, self.tx, self.mesh, state_specs,
+            self.pipe_psum_mask, batch_partition=self.batch_partition,
+            grad_scale=scale)
+        self.eval_step = make_pp_eval_step(
+            self.eval_fn, self.mesh, state_specs,
+            batch_partition=self.batch_partition)

@@ -56,6 +56,28 @@ def _pmean(tree: PyTree, axes=(AXIS_DATA,)) -> PyTree:
     return jax.tree.map(lambda x: jax.lax.pmean(x, axes), tree)
 
 
+def grad_and_metrics(loss_fn: LossFn, params, model_state, batch, rng):
+    """Shared step-front: value_and_grad + metrics normalization.
+    Used by every step builder (bsp/tensor/pipeline) so the core stays
+    in one place; the builders differ only in which collectives wrap
+    the results."""
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    (loss, (new_ms, metrics)), grads = grad_fn(params, model_state, batch,
+                                               rng)
+    metrics = dict(metrics)
+    metrics.setdefault("loss", loss)
+    return grads, new_ms, metrics
+
+
+def apply_update(tx: optax.GradientTransformation, state: "TrainState",
+                 grads, new_ms) -> "TrainState":
+    """Shared step-tail: optimizer update + TrainState rebuild."""
+    updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    return TrainState(step=state.step + 1, params=new_params,
+                      opt_state=new_opt, model_state=new_ms)
+
+
 def _make_shard_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
@@ -71,45 +93,33 @@ def _make_shard_step(
     def shard_step(state: TrainState, batch, rng):
         for ax in reduce_axes:
             rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (new_ms, metrics)), grads = grad_fn(
-            state.params, state.model_state, batch, rng
-        )
-        metrics = dict(metrics)
-        metrics.setdefault("loss", loss)
-
-        if exchanger.exchange_what == "grads":
-            grads = exchanger.exchange(grads)
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-        else:  # 'params': local update, then allreduce parameters
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            avg_exch = (
-                exchanger if exchanger.avg
-                else dataclasses.replace(exchanger, avg=True)
-            )
-            new_params = avg_exch.exchange(new_params)
-            # Momentum buffers live per-shard in 'params' mode; average
-            # them too so state stays replicated (matches the reference's
-            # param-averaging BSP semantics closely enough, and keeps the
-            # SPMD invariant that state is identical on every shard).
-            new_opt = _pmean(new_opt, reduce_axes)
+        grads, new_ms, metrics = grad_and_metrics(
+            loss_fn, state.params, state.model_state, batch, rng)
 
         # Cross-replica sync of mutable collections (BN batch_stats):
         # each shard saw a different micro-batch; average the stats.
         new_ms = _pmean(new_ms, reduce_axes)
-        metrics = _pmean(metrics, reduce_axes)
 
-        return (
-            TrainState(
-                step=state.step + 1,
-                params=new_params,
-                opt_state=new_opt,
-                model_state=new_ms,
-            ),
-            metrics,
-        )
+        if exchanger.exchange_what == "grads":
+            grads = exchanger.exchange(grads)
+            new_state = apply_update(tx, state, grads, new_ms)
+        else:  # 'params': local update, then allreduce parameters
+            new_state = apply_update(tx, state, grads, new_ms)
+            avg_exch = (
+                exchanger if exchanger.avg
+                else dataclasses.replace(exchanger, avg=True)
+            )
+            new_state = new_state.replace(
+                params=avg_exch.exchange(new_state.params),
+                # Momentum buffers live per-shard in 'params' mode;
+                # average them too so state stays replicated (matches
+                # the reference's param-averaging BSP semantics closely
+                # enough, and keeps the SPMD invariant that state is
+                # identical on every shard).
+                opt_state=_pmean(new_state.opt_state, reduce_axes),
+            )
+
+        return new_state, _pmean(metrics, reduce_axes)
 
     return shard_step
 

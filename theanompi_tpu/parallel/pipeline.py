@@ -1,0 +1,208 @@
+"""Pipeline parallelism over the mesh's ``pipe`` axis (GPipe-style).
+
+Beyond reference parity (the reference is data-parallel only,
+SURVEY.md §2.11).  The TPU-native shape of pipelining is NOT the
+reference's process-topology kind: all stages run ONE SPMD program;
+each ``pipe`` shard holds one stage's layer stack (layers arrive
+stacked on a leading axis, sharded ``P('pipe')``), microbatches flow
+stage-to-stage via ``lax.ppermute`` inside a ``lax.scan`` over
+schedule ticks, and the BACKWARD schedule is not hand-written at all —
+jax differentiates through the scan+ppermute, transposing the
+permutation automatically.
+
+Schedule: classic GPipe fill-drain.  With S stages and M microbatches
+the scan runs S-1+M ticks; stage 0 injects microbatch t at tick t,
+stage s computes microbatch t-s at tick t, the last stage emits
+microbatch t-(S-1) at tick t.  Bubble fraction (S-1)/(S-1+M) — choose
+M >= 4*S in real runs.  Activation memory is bounded with
+``jax.checkpoint`` around the per-tick stage body.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import AXIS_PIPE
+
+PyTree = Any
+
+
+def pipeline_apply(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    stage_params: PyTree,
+    microbatches: jax.Array,
+    axis_name: str = AXIS_PIPE,
+    remat: bool = True,
+):
+    """Run ``microbatches`` (M, mb, ...) through the S-stage pipeline.
+
+    Called INSIDE shard_map; ``stage_params`` is this shard's stage
+    (the caller shards the stacked layer axis over ``axis_name``).
+    ``stage_fn(stage_params, x) -> y`` applies one stage to one
+    microbatch; activations must keep one shape across stages.
+
+    Returns (M, mb, ...) outputs that are REAL on the last stage and
+    ZERO elsewhere.  The loss must be masked to the last stage too
+    (``last_stage_mask``) — do NOT broadcast the outputs across
+    ``pipe`` before the loss: a replicated loss seeds the backward on
+    every shard and collective transposes then scale all gradients by
+    S.  With the masked convention each stage's block gradients come
+    out exactly 1x (the cotangent travels the reversed ppermute
+    chain), while gradients of replicated params touched on only one
+    stage (embeddings on stage 0, the head on the last) are zero
+    elsewhere — the training step psums those over ``pipe``
+    (``make_pp_train_step``'s ``pipe_psum_mask``), as it does the
+    masked metrics.
+    """
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    n_ticks = s - 1 + m
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        state = carry
+        # stage 0 injects microbatch t (clamped; ticks >= M feed a
+        # dummy that never reaches the collected outputs)
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, m - 1), keepdims=False)
+        x = jnp.where(idx == 0, inject, state)
+        y = body(stage_params, x)
+        # last stage's result this tick is microbatch t-(S-1); keep it.
+        # ppermute forwards every stage's output to the next stage
+        # (the wrap-around last->0 edge carries values stage 0 ignores)
+        nxt = lax.ppermute(y, axis_name, perm)
+        return nxt, y
+
+    _, ys = lax.scan(tick, jnp.zeros_like(microbatches[0]), jnp.arange(n_ticks))
+
+    # ys on the LAST stage holds the pipeline outputs at ticks
+    # [S-1, S-1+M); every other stage holds intermediates — masked to
+    # zero so downstream per-stage compute stays finite and the
+    # backward seeds only on the last stage.
+    outs = lax.dynamic_slice_in_dim(ys, s - 1, m, axis=0)
+    is_last = (idx == s - 1).astype(outs.dtype)
+    return outs * is_last
+
+
+def last_stage_mask(axis_name: str = AXIS_PIPE, dtype=jnp.float32):
+    """1.0 on the pipeline's last stage, 0.0 elsewhere — multiply the
+    loss (and metrics) by this so the backward seeds exactly once."""
+    s = lax.axis_size(axis_name)
+    return (lax.axis_index(axis_name) == s - 1).astype(dtype)
+
+
+def stack_stages(layer_params: list[PyTree]) -> PyTree:
+    """Stack per-layer param trees onto a leading axis the caller
+    shards over ``pipe`` (layers must share a structure/shape)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
+
+
+def opt_state_specs(tx, opt_state_template: PyTree,
+                    param_specs: PyTree) -> PyTree:
+    """Spec tree matching an optimizer state: param-like leaves (the
+    momentum/trace buffers) carry the param's spec, bookkeeping leaves
+    (counts, injected hyperparams) are replicated."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    grafted = optax.tree_map_params(
+        tx, lambda _leaf, spec: spec, opt_state_template, param_specs)
+    return jax.tree.map(
+        lambda x: x if isinstance(x, P) else P(),
+        grafted, is_leaf=lambda x: isinstance(x, P))
+
+
+def make_pp_train_step(
+    loss_fn: Callable,
+    tx,
+    mesh,
+    state_specs: PyTree,
+    pipe_psum_mask: PyTree,
+    batch_partition=None,
+    data_axis: str = "data",
+    pipe_axis: str = AXIS_PIPE,
+    donate: bool = True,
+    grad_scale: float = 1.0,
+):
+    """shard_map training step for a pipeline-parallel model.
+
+    Unlike the replicated-state BSP step, ``state_specs`` is a
+    per-leaf spec tree: stage (block) params arrive sharded
+    ``P('pipe')`` on their stacked layer axis and their grads stay
+    LOCAL over ``pipe`` (each stage owns its layers); leaves where
+    ``pipe_psum_mask`` is True (every replicated param — embeddings
+    touched only by stage 0's compute path, head/final-norm only by
+    the last stage's masked loss) are psum-ed over ``pipe`` to keep
+    their replicas in sync.  The loss_fn must follow the masked-loss
+    convention (``pipeline_apply`` docstring): loss and metrics are
+    zero off the last stage, so metrics are psum-ed over ``pipe`` here
+    and averaged over ``data`` as usual.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if batch_partition is None:
+        batch_partition = P(data_axis)
+
+    from theanompi_tpu.parallel.bsp import apply_update, grad_and_metrics
+
+    def shard_step(state, batch, rng):
+        rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
+        grads, new_ms, metrics = grad_and_metrics(
+            loss_fn, state.params, state.model_state, batch, rng)
+        grads = jax.tree.map(
+            lambda g, do_psum: lax.psum(g, pipe_axis) if do_psum else g,
+            grads, pipe_psum_mask)
+        grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+        if grad_scale != 1.0:  # reference 'cdd' sum-mode exchange
+            grads = jax.tree.map(lambda g: g * grad_scale, grads)
+        # masked-loss convention: real metrics live on the last stage
+        # only; psum replicates them across 'pipe', then average 'data'
+        metrics = jax.tree.map(lambda x: lax.psum(x, pipe_axis), metrics)
+        metrics = jax.tree.map(lambda x: lax.pmean(x, data_axis), metrics)
+        return apply_update(tx, state, grads, new_ms), metrics
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(state_specs, batch_partition, P()),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_pp_eval_step(
+    eval_fn: Callable,
+    mesh,
+    state_specs: PyTree,
+    batch_partition=None,
+    data_axis: str = "data",
+):
+    """shard_map eval step with the pipeline's per-leaf state specs;
+    masked metrics (real on the last stage only) are psum-ed over
+    ``pipe`` and pmean-ed over ``data``."""
+    from jax.sharding import PartitionSpec as P
+
+    if batch_partition is None:
+        batch_partition = P(data_axis)
+
+    def shard_step(state, batch):
+        metrics = eval_fn(state.params, state.model_state, batch)
+        metrics = jax.tree.map(lambda x: lax.psum(x, AXIS_PIPE), metrics)
+        return jax.tree.map(lambda x: lax.pmean(x, data_axis), metrics)
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(state_specs, batch_partition),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

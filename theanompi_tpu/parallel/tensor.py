@@ -104,19 +104,14 @@ def _gspmd_step(loss_fn: Callable, tx: optax.GradientTransformation,
     ``grad_scale`` realizes the reference's sum-mode (``cdd``) exchange:
     the global-batch mean gradient times the data-axis size equals the
     sum of per-worker mean gradients."""
+    from theanompi_tpu.parallel.bsp import apply_update, grad_and_metrics
 
     def step(state: TrainState, batch, rng):
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (new_ms, metrics)), grads = grad_fn(
-            state.params, state.model_state, batch, rng)
-        metrics = dict(metrics)
-        metrics.setdefault("loss", loss)
+        grads, new_ms, metrics = grad_and_metrics(
+            loss_fn, state.params, state.model_state, batch, rng)
         if grad_scale != 1.0:
             grads = jax.tree.map(lambda g: g * grad_scale, grads)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return TrainState(step=state.step + 1, params=new_params,
-                          opt_state=new_opt, model_state=new_ms), metrics
+        return apply_update(tx, state, grads, new_ms), metrics
 
     return step
 

@@ -50,7 +50,9 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
             if latest is not None:
                 payload = ckpt.restore(latest, like={
                     "state": model.state, "epoch": 0})
-                model.state = payload["state"]
+                # re-establish the model's sharding (a TP model would
+                # otherwise train on replicated restored arrays)
+                model.state = model.adopt_restored_state(payload["state"])
                 start_epoch = int(payload["epoch"]) + 1
                 recorder.load(cfg.snapshot_dir)
                 # fast-forward the LR schedule (reference resume semantics)
@@ -108,15 +110,17 @@ class BSP(Rule):
 
     def _session(self, devs, modelfile, modelclass, config, resume,
                  sync_type, max_epochs=None, checkpoint=True,
-                 model_parallel: int = 1, seq_parallel: int = 1, **kwargs):
-        if model_parallel > 1 or seq_parallel > 1:
+                 model_parallel: int = 1, seq_parallel: int = 1,
+                 pipe_parallel: int = 1, **kwargs):
+        if model_parallel > 1 or seq_parallel > 1 or pipe_parallel > 1:
             from theanompi_tpu.parallel.mesh import (
                 MeshSpec,
                 make_training_mesh,
             )
 
             mesh = make_training_mesh(
-                MeshSpec(data=-1, model=model_parallel, seq=seq_parallel),
+                MeshSpec(data=-1, model=model_parallel, seq=seq_parallel,
+                         pipe=pipe_parallel),
                 devs)
         else:
             mesh = data_mesh(len(devs), devs)
