@@ -57,6 +57,9 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
     p.add_argument("--pipe-parallel", type=int, default=1,
                    help="BSP: pipeline-parallel degree (devices on the "
                         "'pipe' axis; use with transformer_lm_pp)")
+    p.add_argument("--expert-parallel", type=int, default=1,
+                   help="BSP: expert-parallel degree (devices on the "
+                        "'expert' axis; use with transformer_lm_moe)")
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--snapshot-dir", default=None)
@@ -139,12 +142,13 @@ def _run(args, multihost: bool) -> int:
     if args.rule == "BSP":
         kwargs.update(model_parallel=args.model_parallel,
                       seq_parallel=args.seq_parallel,
-                      pipe_parallel=args.pipe_parallel)
+                      pipe_parallel=args.pipe_parallel,
+                      expert_parallel=args.expert_parallel)
     elif (args.model_parallel > 1 or args.seq_parallel > 1
-          or args.pipe_parallel > 1):
-        raise SystemExit("--model-parallel/--seq-parallel/--pipe-parallel "
-                         "are BSP options (async rules are data-parallel "
-                         "per worker)")
+          or args.pipe_parallel > 1 or args.expert_parallel > 1):
+        raise SystemExit("--model-parallel/--seq-parallel/--pipe-parallel/"
+                         "--expert-parallel are BSP options (async rules "
+                         "are data-parallel per worker)")
     if args.rule == "EASGD":
         kwargs.update(tau=args.tau, alpha=args.alpha)
     elif args.rule == "GOSGD":

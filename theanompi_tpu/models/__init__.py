@@ -18,6 +18,8 @@ MODEL_ZOO = {
                           "TransformerLM_TP"),
     "transformer_lm_pp": ("theanompi_tpu.models.transformer",
                           "TransformerLM_PP"),
+    "transformer_lm_moe": ("theanompi_tpu.models.transformer",
+                           "TransformerLM_MoE"),
     # zoo variants (reference lasagne_model_zoo equivalents)
     "vgg19": ("theanompi_tpu.models.model_zoo", "VGG19"),
     "resnet101": ("theanompi_tpu.models.model_zoo", "ResNet101"),

@@ -160,11 +160,26 @@ class TpuModel:
 
     def adopt_restored_state(self, state: "TrainState") -> "TrainState":
         """Hook for checkpoint resume: re-establish this model's device
-        placement on a restored (host-side) state.  Default: as-is —
-        the shard_map step's in_specs place replicated state on entry.
-        Parameter-sharded models (TP: plain jit, shardings inferred
-        from committed arrays) override to re-place per their specs."""
-        return state
+        placement on a restored (host-side) state.  Replicated models:
+        as-is (the shard_map step's in_specs place state on entry).
+        Parameter-sharded models (``param_specs`` set): params AND the
+        optimizer's param-like buffers are re-placed per their specs —
+        essential for the TP path, whose plain-jit step infers
+        shardings from the committed arrays."""
+        if self.param_specs is None:
+            return state
+        import optax
+        from jax.sharding import NamedSharding
+
+        def put(leaf, spec):
+            return jax.device_put(jnp.asarray(leaf),
+                                  NamedSharding(self.mesh, spec))
+
+        return state.replace(
+            params=jax.tree.map(put, state.params, self.param_specs),
+            opt_state=optax.tree_map_params(
+                self.tx, put, state.opt_state, self.param_specs),
+        )
 
     def _init_scaffold(self, config, mesh, verbose, shard_rank, shard_size,
                        data) -> None:
@@ -355,13 +370,11 @@ class TpuModel:
         returns ``fn(state, batch, rng) -> (grads, new_model_state,
         metrics)`` with no optimizer update — the server applies it."""
 
+        from theanompi_tpu.parallel.bsp import grad_and_metrics
+
         def gstep(state: TrainState, batch, rng):
-            grad_fn = jax.value_and_grad(self.loss_fn, has_aux=True)
-            (loss, (new_ms, metrics)), grads = grad_fn(
-                state.params, state.model_state, batch, rng)
-            metrics = dict(metrics)
-            metrics.setdefault("loss", loss)
-            return grads, new_ms, metrics
+            return grad_and_metrics(self.loss_fn, state.params,
+                                    state.model_state, batch, rng)
 
         return jax.jit(gstep)
 
@@ -523,12 +536,32 @@ class TpuModel:
         save_params_npz(path, self.state.params)
         return path
 
+    #: per-leaf PartitionSpecs for parameter-sharded models (TP/PP/MoE
+    #: set this); None = fully replicated params (the DP default)
+    param_specs = None
+
+    def _place_params(self, params: PyTree) -> PyTree:
+        """Put a host-side param tree back on the mesh the way this
+        model shards it (per ``param_specs``, else replicated)."""
+        if self.param_specs is None:
+            return replicate(jax.tree.map(jnp.asarray, params), self.mesh)
+        from jax.sharding import NamedSharding
+
+        return jax.tree.map(
+            lambda x, spec: jax.device_put(
+                jnp.asarray(x), NamedSharding(self.mesh, spec)),
+            params, self.param_specs)
+
     def load(self, path: str) -> None:
-        params = load_params_npz(path, jax.tree.map(np.asarray,
-                                                    self.state.params))
-        self.state = self.state.replace(
-            params=replicate(jax.tree.map(jnp.asarray, params), self.mesh)
-        )
+        """Contract ``load`` — PRESERVES the model's param sharding
+        (a replicated load of a pipe/expert/model-sharded stack would
+        materialize it full-size on every device).  The template is
+        shape/dtype-only: no cross-device gather of sharded weights."""
+        template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self.state.params)
+        params = load_params_npz(path, template)
+        self.state = self.state.replace(params=self._place_params(params))
 
     def cleanup_iter(self) -> None:
         if self._train_prefetcher is not None:

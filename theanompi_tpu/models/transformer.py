@@ -23,7 +23,12 @@ from jax.sharding import PartitionSpec as P
 from theanompi_tpu.data.lm import SeqLM_data
 from theanompi_tpu.models import layers as L
 from theanompi_tpu.models.base import ModelConfig, TpuModel
-from theanompi_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE, AXIS_SEQ
+from theanompi_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_PIPE,
+    AXIS_SEQ,
+)
 from theanompi_tpu.parallel.sequence import (
     attention_reference,
     sequence_attention,
@@ -228,41 +233,8 @@ class TransformerLM_TP(TransformerLM):
         return shard_train_state(params, model_state, self.mesh,
                                  self.param_specs, self.tx)
 
-    def adopt_restored_state(self, state):
-        """Checkpoint resume: re-place restored host arrays per the TP
-        specs (the step is a plain jit whose shardings come from the
-        committed arrays — without this, a resumed model trains fully
-        replicated, defeating TP)."""
-        import optax
-        from jax.sharding import NamedSharding
-
-        def put(leaf, spec):
-            return jax.device_put(jnp.asarray(leaf),
-                                  NamedSharding(self.mesh, spec))
-
-        return state.replace(
-            params=jax.tree.map(put, state.params, self.param_specs),
-            opt_state=optax.tree_map_params(
-                self.tx, put, state.opt_state, self.param_specs),
-        )
-
-    def load(self, path: str) -> None:
-        """Contract ``load`` that PRESERVES the TP sharding (the base
-        implementation would re-replicate params while the optimizer
-        state stays sharded).  The template is shape/dtype-only — no
-        cross-device gather of the sharded weights."""
-        from theanompi_tpu.utils.helper_funcs import load_params_npz
-        from jax.sharding import NamedSharding
-
-        template = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-            self.state.params)
-        params = load_params_npz(path, template)
-        sharded = jax.tree.map(
-            lambda x, spec: jax.device_put(
-                jnp.asarray(x), NamedSharding(self.mesh, spec)),
-            params, self.param_specs)
-        self.state = self.state.replace(params=sharded)
+    # load()/adopt_restored_state(): the base implementations re-place
+    # per self.param_specs (models/base.py) — nothing TP-specific left
 
     def compile_iter_fns(self, sync_type: str = "avg") -> None:
         """TP path: plain jit, shardings from the committed arrays.
@@ -450,8 +422,8 @@ class TransformerLM_PP(TpuModel):
         from theanompi_tpu.parallel.pipeline import (
             make_pp_eval_step,
             make_pp_train_step,
-            opt_state_specs,
         )
+        from theanompi_tpu.parallel.tensor import opt_state_specs
 
         if self.config.steps_per_call > 1:
             raise ValueError("steps_per_call>1 is not implemented for the "
@@ -470,5 +442,234 @@ class TransformerLM_PP(TpuModel):
             self.pipe_psum_mask, batch_partition=self.batch_partition,
             grad_scale=scale)
         self.eval_step = make_pp_eval_step(
+            self.eval_fn, self.mesh, state_specs,
+            batch_partition=self.batch_partition)
+
+
+class AttnBlock(nn.Module):
+    """Pre-LN attention sublayer (LN + q/k/v/o + residual) — the
+    attention half of ``Block``, reused by the MoE variant whose FFN
+    half is the expert-parallel switch layer."""
+
+    d_model: int
+    n_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, _ = x.shape
+        d_head = self.d_model // self.n_heads
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        proj = lambda name: nn.Dense(  # noqa: E731
+            self.d_model, use_bias=False, kernel_init=L.xavier_init(),
+            dtype=self.dtype, name=name)(h)
+        shape = (b, t, self.n_heads, d_head)
+        o = attention_reference(proj("q_proj").reshape(shape),
+                                proj("k_proj").reshape(shape),
+                                proj("v_proj").reshape(shape), causal=True)
+        o = o.reshape((b, t, self.d_model))
+        return x + nn.Dense(self.d_model, use_bias=False,
+                            kernel_init=L.xavier_init(), dtype=self.dtype,
+                            name="o_proj")(o)
+
+
+class TransformerLM_MoE(TpuModel):
+    """Switch-MoE LM over a (data x expert) mesh.
+
+    Every layer's FFN is a top-1-routed mixture of ``n_experts``
+    expert MLPs, sharded over the ``expert`` axis (each shard owns
+    ``n_experts / ep``); tokens reach their expert and return via
+    ``lax.all_to_all`` inside the jitted step (parallel/expert.py).
+    The batch is sharded over BOTH (data, expert) — the expert axis
+    doubles as data parallelism outside the MoE layers, the standard
+    TPU MoE topology.  Router load balancing uses the switch aux loss.
+
+    Like the WGAN/PP models, diverges from the single-flax-module
+    state path and assembles on ``_init_scaffold``.
+    """
+
+    name = "transformer_lm_moe"
+    batch_partition = P((AXIS_DATA, AXIS_EXPERT))
+
+    @classmethod
+    def default_config(cls) -> ModelConfig:
+        return TransformerLM.default_config()
+
+    def __init__(self, config: ModelConfig | None = None, mesh=None,
+                 verbose: bool = True, shard_rank: int = 0,
+                 shard_size: int = 1, data=None, vocab: int = 256,
+                 seq_len: int = 128, n_layers: int = 2, d_model: int = 128,
+                 n_heads: int = 4, n_experts: int = 8,
+                 capacity_factor: float = 1.25, aux_weight: float = 0.01):
+        from theanompi_tpu.parallel.mesh import AXIS_EXPERT as AE
+
+        self._net_cfg = dict(vocab=vocab, seq_len=seq_len,
+                             n_layers=n_layers, d_model=d_model,
+                             n_heads=n_heads)
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self.aux_weight = aux_weight
+        self._init_scaffold(config, mesh, verbose, shard_rank, shard_size,
+                            data)
+        ep = self.mesh.shape[AE]
+        if n_experts % ep != 0:
+            raise ValueError(f"n_experts={n_experts} not divisible by "
+                             f"expert-parallel degree {ep}")
+        # tokens ride BOTH axes; recompute the data-parallel width AND
+        # everything derived from it — notably the worker-scaled LR,
+        # which _init_scaffold computed from the data axis alone
+        self.n_workers = self.mesh.shape[AXIS_DATA] * ep
+        self.global_batch = self.batch_size * self.n_workers
+        if self.config.lr_scale_with_workers:
+            from theanompi_tpu.utils.helper_funcs import scale_lr
+
+            self._base_lr = scale_lr(self.config.learning_rate,
+                                     self.n_workers,
+                                     self.config.lr_scale_with_workers)
+
+        from theanompi_tpu.parallel.tensor import shard_train_state
+
+        dtype = self._compute_dtype()
+        d, ff = d_model, 4 * d_model
+        self.attn_mod = AttnBlock(d, n_heads, dtype=dtype)
+        self.ln_mod = nn.LayerNorm(dtype=dtype)
+        self.head_mod = nn.Dense(vocab, kernel_init=L.xavier_init(),
+                                 dtype=dtype)
+        self.embed_mod = nn.Embed(vocab, d,
+                                  embedding_init=L.gaussian_init(0.02))
+
+        rng = jax.random.key(self.config.seed)
+        tok = jnp.zeros((2, seq_len), jnp.int32)
+        x = jnp.zeros((2, seq_len, d), jnp.float32)
+
+        def expert_init(key, layer):
+            k1, k2 = jax.random.split(jax.random.fold_in(key, layer))
+            he = (2.0 / d) ** 0.5
+            xa = (6.0 / (ff + d)) ** 0.5
+            return {
+                "up_kernel": he * jax.random.normal(
+                    k1, (n_experts, d, ff), jnp.float32),
+                "up_bias": jnp.zeros((n_experts, ff), jnp.float32),
+                "down_kernel": jax.random.uniform(
+                    k2, (n_experts, ff, d), jnp.float32, -xa, xa),
+                "down_bias": jnp.zeros((n_experts, d), jnp.float32),
+            }
+
+        params = {
+            "embed": self.embed_mod.init(rng, tok)["params"],
+            "pos_emb": L.gaussian_init(0.02)(
+                jax.random.fold_in(rng, 1), (seq_len, d)),
+            "attn": [self.attn_mod.init(jax.random.fold_in(rng, 10 + i),
+                                        x)["params"]
+                     for i in range(n_layers)],
+            "moe_ln": [self.ln_mod.init(rng, x)["params"]
+                       for _ in range(n_layers)],
+            "router": [L.gaussian_init(0.02)(
+                jax.random.fold_in(rng, 100 + i), (d, n_experts))
+                for i in range(n_layers)],
+            "experts": [expert_init(jax.random.fold_in(rng, 200), i)
+                        for i in range(n_layers)],
+            "ln_f": self.ln_mod.init(rng, x)["params"],
+            "head": self.head_mod.init(jax.random.fold_in(rng, 2),
+                                       x)["params"],
+        }
+        self.tx = self._build_optimizer(self._base_lr)
+
+        def leaf_spec(path, leaf):
+            in_experts = any(getattr(k, "key", None) == "experts"
+                             for k in path)
+            return P(AE) if in_experts else P()
+
+        self.param_specs = jax.tree_util.tree_map_with_path(leaf_spec,
+                                                            params)
+        self.expert_mask = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: any(getattr(k, "key", None) == "experts"
+                                   for k in path), params)
+        self.state = shard_train_state(params, {}, self.mesh,
+                                       self.param_specs, self.tx)
+
+    def _input_dtype(self):
+        return jnp.int32
+
+    def build_data(self):
+        c = self._net_cfg
+        return SeqLM_data(vocab=c["vocab"], seq_len=c["seq_len"],
+                          seed=self.config.seed)
+
+    # -- forward (runs inside shard_map over the (data, expert) axes) -------
+
+    def _forward(self, params, tokens):
+        from theanompi_tpu.parallel.expert import moe_ffn
+        from theanompi_tpu.parallel.mesh import AXIS_EXPERT as AE
+
+        b, t = tokens.shape
+        d = self._net_cfg["d_model"]
+        x = self.embed_mod.apply({"params": params["embed"]}, tokens)
+        x = (x + params["pos_emb"][None, :t]).astype(self._compute_dtype())
+
+        def apply_expert(p, tok):
+            h = jnp.maximum(tok @ p["up_kernel"] + p["up_bias"], 0.0)
+            return h @ p["down_kernel"] + p["down_bias"]
+
+        aux_total = 0.0
+        for layer in range(self._net_cfg["n_layers"]):
+            x = self.attn_mod.apply({"params": params["attn"][layer]}, x)
+            h = self.ln_mod.apply({"params": params["moe_ln"][layer]}, x)
+            out, aux = moe_ffn(h.reshape(b * t, d), params["router"][layer],
+                               params["experts"][layer], apply_expert,
+                               capacity_factor=self.capacity_factor,
+                               axis_name=AE)
+            x = x + out.reshape(b, t, d)
+            aux_total = aux_total + aux
+        h = self.ln_mod.apply({"params": params["ln_f"]}, x)
+        logits = self.head_mod.apply({"params": params["head"]}, h)
+        return logits.astype(jnp.float32), aux_total
+
+    def loss_fn(self, params, model_state, batch, rng):
+        del rng
+        tokens, targets = batch
+        logits, aux = self._forward(params, tokens)
+        v = logits.shape[-1]
+        ce = L.softmax_cross_entropy(logits.reshape(-1, v),
+                                     targets.reshape(-1))
+        err = L.error_rate(logits.reshape(-1, v), targets.reshape(-1))
+        loss = ce + self.aux_weight * aux / self._net_cfg["n_layers"]
+        return loss, (model_state, {"loss": ce, "error": err,
+                                    "aux": aux})
+
+    def eval_fn(self, params, model_state, batch):
+        tokens, targets = batch
+        logits, _ = self._forward(params, tokens)
+        v = logits.shape[-1]
+        return {"loss": L.softmax_cross_entropy(logits.reshape(-1, v),
+                                                targets.reshape(-1)),
+                "error": L.error_rate(logits.reshape(-1, v),
+                                      targets.reshape(-1))}
+
+    def compile_iter_fns(self, sync_type: str = "avg") -> None:
+        from theanompi_tpu.parallel.bsp import TrainState
+        from theanompi_tpu.parallel.expert import (
+            make_moe_eval_step,
+            make_moe_train_step,
+        )
+        from theanompi_tpu.parallel.tensor import opt_state_specs
+
+        if self.config.steps_per_call > 1:
+            raise ValueError("steps_per_call>1 is not implemented for the "
+                             "expert-parallel path")
+        state_specs = TrainState(
+            step=P(),
+            params=self.param_specs,
+            opt_state=opt_state_specs(self.tx, self.state.opt_state,
+                                      self.param_specs),
+            model_state={},
+        )
+        expert_mask_state = self.expert_mask
+        scale = (float(self.n_workers) if sync_type == "cdd" else 1.0)
+        self.train_step = make_moe_train_step(
+            self.loss_fn, self.tx, self.mesh, state_specs,
+            expert_mask_state, batch_partition=self.batch_partition,
+            grad_scale=scale)
+        self.eval_step = make_moe_eval_step(
             self.eval_fn, self.mesh, state_specs,
             batch_partition=self.batch_partition)

@@ -1,0 +1,202 @@
+"""Expert parallelism over the mesh's ``expert`` axis (switch-style MoE).
+
+Beyond reference parity (the reference is data-parallel only,
+SURVEY.md §2.11) — the fifth and last reserved mesh axis becomes real.
+The canonical TPU pattern: experts are sharded over ``expert`` (each
+shard owns ``E / ep`` expert FFNs, params stacked on a leading expert
+axis ``P('expert')``), tokens are batch-sharded over data axes, and a
+pair of ``lax.all_to_all`` collectives regroups tokens by expert and
+back inside the jitted step.
+
+Routing is top-1 (switch) with a fixed capacity per expert — static
+shapes, as XLA requires: each token picks its argmax expert, tokens
+beyond an expert's capacity are dropped (their combine weight is
+zero), and the router is trained with the standard load-balancing
+auxiliary loss (mean fraction routed x mean router probability, scaled
+by E).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from theanompi_tpu.parallel.mesh import AXIS_EXPERT
+
+PyTree = Any
+
+
+def top1_dispatch(router_logits: jax.Array, capacity: int):
+    """Build switch-routing dispatch/combine tensors for one shard.
+
+    ``router_logits``: (n_tokens, E).  Returns
+    ``dispatch`` (E, capacity, n_tokens) one-hot — token t is slot s of
+    expert e; ``combine`` (n_tokens, E, capacity) — router-prob weights
+    (zero for dropped tokens); and the load-balancing aux loss.
+    """
+    n, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)            # (n,)
+    expert_prob = jnp.max(probs, axis=-1)              # (n,)
+
+    # position of each token within its expert's queue
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # (n, E)
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1        # (n, E)
+    pos_in_expert = position.max(axis=-1)                     # (n,)
+    keep = pos_in_expert < capacity
+
+    # aux loss (Switch Transformer eq. 4): E * mean(frac_tokens) . mean(prob)
+    frac_tokens = onehot.astype(jnp.float32).mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    slot = jnp.where(keep, pos_in_expert, 0)
+    dispatch = (
+        jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)[:, :, None]
+        * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, None, :]
+        * keep[:, None, None]
+    )                                                   # (n, E, capacity)
+    combine = dispatch * expert_prob[:, None, None]
+    return jnp.moveaxis(dispatch, 0, -1), combine, aux  # (E, cap, n), ...
+
+
+def moe_ffn(x: jax.Array, router_kernel: jax.Array, expert_params: PyTree,
+            apply_expert, capacity_factor: float = 1.25,
+            axis_name: str | None = AXIS_EXPERT):
+    """Switch-MoE FFN over tokens ``x`` (n_tokens, d).
+
+    ``expert_params`` leaves carry a leading LOCAL-expert axis (E/ep
+    per shard when ``axis_name`` is a real mesh axis; E when None or
+    inside a size-1 axis).  ``apply_expert(params_e, tokens) -> out``
+    applies one expert FFN; it is vmapped over local experts.
+
+    With expert parallelism the dispatched tokens cross shards via
+    ``all_to_all`` (tokens -> owning expert's shard) and return the
+    same way; XLA schedules both on ICI.  Returns (out, aux_loss).
+    """
+    n, d = x.shape
+    ep = lax.axis_size(axis_name) if axis_name is not None else 1
+    e_local = jax.tree.leaves(expert_params)[0].shape[0]
+    e = e_local * ep
+    capacity = max(1, int(capacity_factor * n / e))
+
+    router_logits = x.astype(jnp.float32) @ router_kernel  # (n, E)
+    dispatch, combine, aux = top1_dispatch(router_logits, capacity)
+
+    # tokens for every expert, gathered from this shard: (E, cap, d)
+    expert_in = jnp.einsum("ecn,nd->ecd", dispatch, x.astype(jnp.float32))
+
+    if ep > 1:
+        # outbound: shard j receives, from every source shard s, the
+        # (e_local, cap, d) block of tokens routed to ITS experts —
+        # result (ep[source], e_local, cap, d) -> (e_local, ep*cap, d)
+        expert_in = expert_in.reshape(ep, e_local, capacity, d)
+        expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                                   concat_axis=0, tiled=False)
+        expert_in = jnp.moveaxis(expert_in, 0, 1)  # (E_local, ep, cap, d)
+        expert_in = expert_in.reshape(e_local, ep * capacity, d)
+    # apply this shard's experts
+    expert_out = jax.vmap(apply_expert)(expert_params, expert_in)
+    if ep > 1:
+        # return trip (exact mirror): send each source shard its token
+        # slots back; dim0 of the result indexes the expert-owner
+        # shard, so reshaping restores the global (E, cap, d) layout
+        expert_out = expert_out.reshape(e_local, ep, capacity, d)
+        expert_out = jnp.moveaxis(expert_out, 1, 0)  # (ep, E_local, cap, d)
+        expert_out = lax.all_to_all(expert_out, axis_name, split_axis=0,
+                                    concat_axis=0, tiled=False)
+        expert_out = expert_out.reshape(e, capacity, d)
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return out.astype(x.dtype), aux
+
+
+def make_moe_train_step(
+    loss_fn,
+    tx,
+    mesh,
+    state_specs: PyTree,
+    expert_mask: PyTree,
+    batch_partition=None,
+    data_axis: str = "data",
+    expert_axis: str = AXIS_EXPERT,
+    donate: bool = True,
+    grad_scale: float = 1.0,
+):
+    """shard_map training step for an expert-parallel model.
+
+    The batch is sharded over BOTH ``(data, expert)`` — for non-MoE
+    layers the expert axis is just more data parallelism — so grads of
+    replicated params are pmean-ed over both axes, while leaves where
+    ``expert_mask`` is True (the expert FFN stacks, sharded
+    ``P('expert')``) already saw every token routed to them via the
+    all_to_all and are pmean-ed over ``data`` only.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.bsp import apply_update, grad_and_metrics
+
+    if batch_partition is None:
+        batch_partition = P((data_axis, expert_axis))
+
+    def shard_step(state, batch, rng):
+        for ax in (data_axis, expert_axis):
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
+        grads, new_ms, metrics = grad_and_metrics(
+            loss_fn, state.params, state.model_state, batch, rng)
+        # expert leaves: the all_to_all TRANSPOSE already accumulated
+        # every expert-axis shard's cotangent onto the owning shard (a
+        # SUM over the axis, where replicated params get a per-shard
+        # local grad) — divide by ep so expert grads live on the same
+        # global-mean-loss scale as everything else, then average the
+        # data replicas.  Non-expert leaves: plain mean over both axes.
+        ep = lax.axis_size(expert_axis)
+        grads = jax.tree.map(
+            lambda g, is_exp: (
+                lax.pmean(g, data_axis) / ep if is_exp
+                else lax.pmean(g, (data_axis, expert_axis))),
+            grads, expert_mask)
+        if grad_scale != 1.0:  # reference 'cdd' sum-mode exchange
+            grads = jax.tree.map(lambda g: g * grad_scale, grads)
+        metrics = jax.tree.map(
+            lambda x: lax.pmean(x, (data_axis, expert_axis)), metrics)
+        return apply_update(tx, state, grads, new_ms), metrics
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(state_specs, batch_partition, P()),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_moe_eval_step(
+    eval_fn,
+    mesh,
+    state_specs: PyTree,
+    batch_partition=None,
+    data_axis: str = "data",
+    expert_axis: str = AXIS_EXPERT,
+):
+    from jax.sharding import PartitionSpec as P
+
+    if batch_partition is None:
+        batch_partition = P((data_axis, expert_axis))
+
+    def shard_step(state, batch):
+        metrics = eval_fn(state.params, state.model_state, batch)
+        return jax.tree.map(
+            lambda x: lax.pmean(x, (data_axis, expert_axis)), metrics)
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(state_specs, batch_partition),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -104,21 +104,6 @@ def stack_stages(layer_params: list[PyTree]) -> PyTree:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
 
 
-def opt_state_specs(tx, opt_state_template: PyTree,
-                    param_specs: PyTree) -> PyTree:
-    """Spec tree matching an optimizer state: param-like leaves (the
-    momentum/trace buffers) carry the param's spec, bookkeeping leaves
-    (counts, injected hyperparams) are replicated."""
-    import optax
-    from jax.sharding import PartitionSpec as P
-
-    grafted = optax.tree_map_params(
-        tx, lambda _leaf, spec: spec, opt_state_template, param_specs)
-    return jax.tree.map(
-        lambda x: x if isinstance(x, P) else P(),
-        grafted, is_leaf=lambda x: isinstance(x, P))
-
-
 def make_pp_train_step(
     loss_fn: Callable,
     tx,

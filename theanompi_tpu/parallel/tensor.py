@@ -69,6 +69,19 @@ def transformer_tp_specs(params: PyTree) -> PyTree:
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
+def opt_state_specs(tx, opt_state_template: PyTree,
+                    param_specs: PyTree) -> PyTree:
+    """Spec tree matching an optimizer state: param-like leaves (the
+    momentum/trace buffers) carry the param's spec, bookkeeping leaves
+    (counts, injected hyperparams) are replicated.  Shared by every
+    param-sharded step builder (TP/PP/MoE)."""
+    grafted = optax.tree_map_params(
+        tx, lambda _leaf, spec: spec, opt_state_template, param_specs)
+    return jax.tree.map(
+        lambda x: x if isinstance(x, P) else P(),
+        grafted, is_leaf=lambda x: isinstance(x, P))
+
+
 def shard_train_state(params: PyTree, model_state: PyTree, mesh: Mesh,
                       param_specs: PyTree,
                       tx: optax.GradientTransformation) -> TrainState:

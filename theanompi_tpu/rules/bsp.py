@@ -111,8 +111,10 @@ class BSP(Rule):
     def _session(self, devs, modelfile, modelclass, config, resume,
                  sync_type, max_epochs=None, checkpoint=True,
                  model_parallel: int = 1, seq_parallel: int = 1,
-                 pipe_parallel: int = 1, **kwargs):
-        if model_parallel > 1 or seq_parallel > 1 or pipe_parallel > 1:
+                 pipe_parallel: int = 1, expert_parallel: int = 1,
+                 **kwargs):
+        if (model_parallel > 1 or seq_parallel > 1 or pipe_parallel > 1
+                or expert_parallel > 1):
             from theanompi_tpu.parallel.mesh import (
                 MeshSpec,
                 make_training_mesh,
@@ -120,7 +122,7 @@ class BSP(Rule):
 
             mesh = make_training_mesh(
                 MeshSpec(data=-1, model=model_parallel, seq=seq_parallel,
-                         pipe=pipe_parallel),
+                         pipe=pipe_parallel, expert=expert_parallel),
                 devs)
         else:
             mesh = data_mesh(len(devs), devs)
