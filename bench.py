@@ -23,14 +23,46 @@ carries the e2e leg and the recorder cross-check (VERDICT r1 #6).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
+BASELINE_PER_CHIP = 2500.0 / 16.0  # north-star v5e-16 target, per chip
+E2E_STEPS = int(os.environ.get("THEANOMPI_TPU_BENCH_E2E_STEPS", "64"))
+BATCH_PER_CHIP = int(os.environ.get("THEANOMPI_TPU_BENCH_BATCH", "128"))
+N_STEPS = int(os.environ.get("THEANOMPI_TPU_BENCH_STEPS", "30"))
+
+
+def _probe_backend(timeout_s: int = 300) -> tuple[str | None, str]:
+    """Initialize the backend in a SUBPROCESS first: a wedged axon
+    tunnel hangs ``jax.devices()`` for ~25 min before failing, which
+    would look like a silent bench hang.  Returns (platform, error):
+    platform is None if the backend is unusable, with the actual
+    failure mode in ``error``."""
+    # this image's sitecustomize pre-registers the axon plugin and
+    # ignores the env var alone — apply it via jax.config like the
+    # test conftest does, so JAX_PLATFORMS=cpu runs bench on CPU
+    code = ("import os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "print(jax.devices()[0].platform)")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, (f"device init did not complete within {timeout_s}s "
+                      "(wedged tunnel?)")
+    out = r.stdout.strip().splitlines()
+    if r.returncode == 0 and out:
+        return out[-1], ""
+    tail = "; ".join(r.stderr.strip().splitlines()[-3:])
+    return None, f"backend init failed (rc={r.returncode}): {tail}"
+
+
 import jax
 import numpy as np
-
-BASELINE_PER_CHIP = 2500.0 / 16.0  # north-star v5e-16 target, per chip
-E2E_STEPS = 64
 
 
 def fenced_loss(metrics) -> float:
@@ -38,7 +70,21 @@ def fenced_loss(metrics) -> float:
     return float(metrics["loss"])
 
 
-def main() -> None:
+def main() -> int:
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        platform, err = "cpu", ""  # no tunnel involved; probe is moot
+    else:
+        platform, err = _probe_backend()
+    if platform is None:
+        print(json.dumps({
+            "metric": "resnet50_imagenet_bsp_images_per_sec_per_chip",
+            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "detail": {"error": f"no measurement taken — {err}"},
+        }))
+        return 1
+
     from theanompi_tpu.models.base import ModelConfig
     from theanompi_tpu.models.resnet50 import ResNet50
     from theanompi_tpu.data.imagenet import ImageNet_data
@@ -49,7 +95,7 @@ def main() -> None:
     n_chips = len(devices)
     mesh = data_mesh(n_chips, devices)
 
-    batch_per_chip = 128
+    batch_per_chip = BATCH_PER_CHIP
     global_batch = batch_per_chip * n_chips
 
     class BenchResNet50(ResNet50):
@@ -75,7 +121,7 @@ def main() -> None:
         state, metrics = model.train_step(state, staged[i % len(staged)], rng)
     fenced_loss(metrics)
 
-    n_steps = 30
+    n_steps = N_STEPS
     t0 = time.perf_counter()
     for i in range(n_steps):
         state, metrics = model.train_step(state, staged[i % len(staged)], rng)
@@ -125,6 +171,7 @@ def main() -> None:
             "backend": jax.default_backend(),
         },
     }))
+    return 0
 
 
 if __name__ == "__main__":
