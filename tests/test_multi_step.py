@@ -100,6 +100,7 @@ class TestModelPlumbing:
                       modelclass="TinyCifar", config=cfg, checkpoint=False)
             rule.wait()
 
+    @pytest.mark.slow
     def test_run_bsp_session_with_multi_step(self, mesh8, tmp_path):
         from tests._tiny_models import TinyCifar
         from theanompi_tpu.rules.bsp import run_bsp_session

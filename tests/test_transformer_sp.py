@@ -26,6 +26,8 @@ def dp_sp_mesh():
 
 
 class TestTransformerSP:
+    @pytest.mark.slow  # convergence proof; the numeric contract is
+    # test_dp_sp_equivalent_to_pure_dp below
     def test_learns_synthetic_grammar(self, dp_sp_mesh):
         m = make_lm(dp_sp_mesh)
         m.compile_iter_fns("avg")
