@@ -1,0 +1,133 @@
+"""Summarize a ``jax.profiler`` trace into an op-level time breakdown.
+
+The MFU work (VERDICT r1 #2) needs to attribute step time to ops
+before attacking it; TensorBoard's profile plugin isn't in this image,
+so this parses the Chrome-trace JSON that ``jax.profiler.trace`` /
+``utils/profiling.py`` (``THEANOMPI_TPU_PROFILE=dir``) writes and
+prints, per trace: total span, busiest thread, and the top ops by
+summed duration with a coarse category (conv / matmul / fusion /
+copy / collective / infeed).
+
+Usage:
+    python tools/analyze_trace.py /tmp/trace_dir [--top 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_traces(root: str) -> list[str]:
+    pats = [os.path.join(root, "**", "*.trace.json.gz"),
+            os.path.join(root, "**", "*.trace.json")]
+    out: list[str] = []
+    for p in pats:
+        out.extend(glob.glob(p, recursive=True))
+    return sorted(out)
+
+
+def load_events(path: str) -> list[dict]:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        doc = json.load(f)
+    return [e for e in doc.get("traceEvents", [])
+            if e.get("ph") == "X" and "dur" in e]
+
+
+CATEGORIES = (
+    ("conv", ("conv",)),
+    ("matmul", ("dot", "einsum", "matmul")),
+    ("collective", ("all-reduce", "all-gather", "all-to-all",
+                    "collective", "reduce-scatter", "permute", "psum")),
+    ("copy/transpose", ("copy", "transpose", "bitcast", "reshape")),
+    ("infeed/outfeed", ("infeed", "outfeed", "transfer")),
+    ("fusion", ("fusion", "fused")),
+)
+
+
+def categorize(name: str) -> str:
+    low = name.lower()
+    for cat, keys in CATEGORIES:
+        if any(k in low for k in keys):
+            return cat
+    return "other"
+
+
+def summarize(path: str, top: int) -> None:
+    events = load_events(path)
+    if not events:
+        print(f"{path}: no complete events")
+        return
+    # pick the device op stream as the (pid, tid) with the largest
+    # interval-UNION busy time: host threads carry nested runtime/
+    # Python spans whose summed durations would out-count the real op
+    # stream if we ranked by plain sums
+    def interval_union(evs) -> float:
+        union, cur0, cur1 = 0.0, None, None
+        for ev in sorted(evs, key=lambda e: e["ts"]):
+            s, e_ = ev["ts"], ev["ts"] + ev["dur"]
+            if cur1 is None or s > cur1:
+                union += 0.0 if cur1 is None else cur1 - cur0
+                cur0, cur1 = s, e_
+            else:
+                cur1 = max(cur1, e_)
+        return union if cur1 is None else union + (cur1 - cur0)
+
+    streams: dict[tuple, list] = collections.defaultdict(list)
+    for e in events:
+        streams[(e.get("pid"), e.get("tid"))].append(e)
+    (pid, tid), union_us = max(
+        ((k_, interval_union(v)) for k_, v in streams.items()),
+        key=lambda kv: kv[1])
+    stream = streams[(pid, tid)]
+    stream_us = sum(e["dur"] for e in stream)
+    t0 = min(e["ts"] for e in stream)
+    t1 = max(e["ts"] + e["dur"] for e in stream)
+    span_us = t1 - t0
+
+    by_op: dict[str, list[float]] = collections.defaultdict(
+        lambda: [0.0, 0])
+    by_cat: dict[str, float] = collections.defaultdict(float)
+    for e in stream:
+        rec = by_op[e["name"]]
+        rec[0] += e["dur"]
+        rec[1] += 1
+        by_cat[categorize(e["name"])] += e["dur"]
+
+    print(f"== {os.path.relpath(path)}")
+    print(f"   busiest stream pid={pid} tid={tid}: "
+          f"{union_us / 1e3:.2f} ms busy over {span_us / 1e3:.2f} ms span "
+          f"({100 * union_us / max(span_us, 1):.1f}% occupancy, "
+          f"{len(stream)} events; op shares below sum nested spans)")
+    print("   -- by category --")
+    for cat, us in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        print(f"   {100 * us / stream_us:5.1f}%  {us / 1e3:9.2f} ms  {cat}")
+    print(f"   -- top {top} ops --")
+    rows = sorted(by_op.items(), key=lambda kv: -kv[1][0])[:top]
+    for name, (us, n) in rows:
+        print(f"   {100 * us / stream_us:5.1f}%  {us / 1e3:9.2f} ms  "
+              f"x{n:<4d} {name[:90]}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=30)
+    args = ap.parse_args()
+    traces = find_traces(args.trace_dir)
+    if not traces:
+        print(f"no *.trace.json[.gz] under {args.trace_dir}", file=sys.stderr)
+        return 1
+    for t in traces:
+        summarize(t, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
