@@ -127,17 +127,12 @@ def main() -> int:
     # ---- leg 1: device step over pre-staged uint8 batches ----
     host_it = model.data.train_batches(0, global_batch)
     if k > 1:
-        # stack + spec exactly as begin_epoch does for train_step_multi
-        from jax.sharding import PartitionSpec as P
-
         from theanompi_tpu.models.base import _stack_host_batches
-        from theanompi_tpu.parallel.mesh import AXIS_DATA
 
-        per_step = (model.batch_partition if model.batch_partition
-                    is not None else P(AXIS_DATA))
         stacked_it = _stack_host_batches(host_it, k)
         staged = [shard_batch(next(stacked_it), mesh,
-                              spec=P(None, *per_step)) for _ in range(2)]
+                              spec=model.stacked_batch_spec())
+                  for _ in range(2)]
         step_fn = model.train_step_multi
     else:
         staged = [shard_batch(next(host_it), mesh) for _ in range(4)]

@@ -115,3 +115,29 @@ def test_launcher_config_overrides_apply(tmp_path):
                               learning_rate=0.5,
                               snapshot_dir=str(tmp_path))
     assert cfg.batch_size == 32 and cfg.learning_rate == 0.5
+
+
+def test_set_overrides_typed():
+    from theanompi_tpu.launcher import _parse_config_sets
+
+    out = _parse_config_sets([
+        "optimizer=lars", "warmup_epochs=5", "lr_schedule=cosine",
+        "momentum=0.95", "nesterov=true", "track_top5=0",
+        "lr_decay_epochs=30,60,80", "data_dir=none",
+    ])
+    assert out == {"optimizer": "lars", "warmup_epochs": 5,
+                   "lr_schedule": "cosine", "momentum": 0.95,
+                   "nesterov": True, "track_top5": False,
+                   "lr_decay_epochs": (30, 60, 80), "data_dir": None}
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ("no_such_field=1", "unknown ModelConfig field"),
+    ("warmup_epochs", "expects K=V"),
+    ("nesterov=maybe", "expected a bool"),
+])
+def test_set_overrides_rejected(bad, msg):
+    from theanompi_tpu.launcher import _parse_config_sets
+
+    with pytest.raises(SystemExit, match=msg):
+        _parse_config_sets([bad])

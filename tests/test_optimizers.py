@@ -1,0 +1,104 @@
+"""Optimizer zoo + LR schedule extensions (reference parity was
+SGD+momentum only — SURVEY.md §2.8 layers lib 'SGD/momentum update
+builders'; the zoo adds the families large-batch TPU recipes use)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.utils.helper_funcs import (
+    OPTIMIZERS,
+    build_optimizer,
+    get_learning_rate,
+    set_learning_rate,
+)
+
+
+@pytest.mark.parametrize("name", OPTIMIZERS)
+def test_build_optimizer_updates_and_lr_mutable(name):
+    """Every family: update() runs, moves params, and the lr is
+    mutable in-place (the adjust_hyperp / remote-service contract)."""
+    params = {"w": jnp.ones((4, 3)), "b": jnp.zeros(3)}
+    tx = build_optimizer(0.1, optimizer=name, momentum=0.9,
+                         weight_decay=1e-4)
+    state = tx.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    updates, state = tx.update(grads, state, params)
+    new_params = jax.tree.map(lambda p, u: p + u, params, updates)
+    assert not np.allclose(np.asarray(new_params["w"]),
+                           np.asarray(params["w"]))
+    assert get_learning_rate(state) == pytest.approx(0.1)
+    state = set_learning_rate(state, 0.01)
+    assert get_learning_rate(state) == pytest.approx(0.01)
+
+
+def test_unknown_optimizer_rejected():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        build_optimizer(0.1, optimizer="sgdm")
+
+
+def test_adamw_decay_is_decoupled():
+    """adamw applies decay directly to params (update == -lr*wd*p on
+    zero grads), while adam's decay rides through the adaptive
+    normalization — the magnitudes must differ accordingly."""
+    params = {"w": jnp.full((4,), 2.0)}
+    zeros = {"w": jnp.zeros((4,))}
+    tx_w = build_optimizer(0.1, optimizer="adamw", weight_decay=0.01)
+    up_w, _ = tx_w.update(zeros, tx_w.init(params), params)
+    np.testing.assert_allclose(np.asarray(up_w["w"]),
+                               -0.1 * 0.01 * 2.0, rtol=1e-6)
+    # adam normalizes the decayed-grad signal, so its first update is
+    # ~= -lr regardless of wd magnitude — NOT -lr*wd*p
+    tx_a = build_optimizer(0.1, optimizer="adam", weight_decay=0.01)
+    up_a, _ = tx_a.update(zeros, tx_a.init(params), params)
+    assert abs(float(up_a["w"][0])) > 10 * abs(float(up_w["w"][0]))
+
+
+class TestSchedules:
+    def make(self, mesh8, **kw):
+        from tests._tiny_models import TinyCifar
+
+        cfg = ModelConfig(batch_size=2, print_freq=0, **kw)
+        return TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+
+    def test_warmup_then_cosine(self, mesh8):
+        m = self.make(mesh8, n_epochs=25, learning_rate=0.4,
+                      lr_schedule="cosine", warmup_epochs=5)
+        # linear ramp: (epoch+1)/warmup
+        assert m.adjust_hyperp(0) == pytest.approx(0.4 / 5)
+        assert m.adjust_hyperp(4) == pytest.approx(0.4)
+        # cosine over the remaining 20 epochs
+        assert m.adjust_hyperp(5) == pytest.approx(0.4)
+        assert m.adjust_hyperp(15) == pytest.approx(0.2)
+        assert m.adjust_hyperp(25) == pytest.approx(0.0, abs=1e-12)
+
+    def test_warmup_applies_to_step_schedule_too(self, mesh8):
+        m = self.make(mesh8, n_epochs=10, learning_rate=0.1,
+                      lr_schedule="step", lr_decay_epochs=(6,),
+                      lr_decay_factor=0.1, warmup_epochs=2)
+        assert m.adjust_hyperp(0) == pytest.approx(0.05)
+        assert m.adjust_hyperp(1) == pytest.approx(0.1)
+        assert m.adjust_hyperp(2) == pytest.approx(0.1)
+        assert m.adjust_hyperp(7) == pytest.approx(0.01)
+
+    def test_model_trains_with_adamw(self, mesh8):
+        """The zoo plugs into the BSP spine end to end."""
+        from theanompi_tpu.utils.recorder import Recorder
+
+        m = self.make(mesh8, n_epochs=1, learning_rate=1e-3,
+                      optimizer="adamw", weight_decay=0.01)
+        m.compile_iter_fns("avg")
+        rec = Recorder(rank=0, size=8, print_freq=0)
+        m.begin_epoch(0)
+        for i in range(3):
+            m.train_iter(i, rec)
+        m._flush_metrics(rec)
+        assert np.isfinite(rec.train_losses).all()
+        # the remote-service wire format round-trips this optimizer
+        rebuilt = build_optimizer(**m.optimizer_hyperparams())
+        rebuilt.init(m_params := jax.tree.map(np.asarray,
+                                              m.state.params))
+        del m_params
+        m.cleanup()

@@ -63,6 +63,12 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--set", action="append", default=[], metavar="K=V",
+                   dest="config_sets",
+                   help="override any ModelConfig field, repeatable "
+                        "(e.g. --set optimizer=lars --set "
+                        "warmup_epochs=5 --set lr_schedule=cosine); "
+                        "values are parsed by the field's declared type")
     p.add_argument("--tau", type=int, default=10, help="EASGD sync period")
     p.add_argument("--alpha", type=float, default=0.5,
                    help="EASGD elastic coefficient")
@@ -91,6 +97,49 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
         p.add_argument("--nhosts", type=int, required=True)
         p.add_argument("--host-id", type=int, required=True)
     return p
+
+
+def _parse_config_sets(pairs: list[str]) -> dict:
+    """``--set k=v`` strings → typed ModelConfig overrides (the typed
+    escape hatch so every new config field doesn't need its own flag)."""
+    import dataclasses
+
+    from theanompi_tpu.models.base import ModelConfig
+
+    fields = {f.name: f for f in dataclasses.fields(ModelConfig)}
+    out: dict = {}
+    for pair in pairs:
+        key, sep, raw = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--set expects K=V, got {pair!r}")
+        if key not in fields:
+            raise SystemExit(f"--set: unknown ModelConfig field {key!r}; "
+                             f"valid: {', '.join(sorted(fields))}")
+        default = fields[key].default
+        low = raw.lower()
+        if low in ("none", "null"):
+            out[key] = None
+        elif isinstance(default, bool):
+            if low not in ("true", "false", "1", "0"):
+                raise SystemExit(f"--set {key}: expected a bool, got {raw!r}")
+            out[key] = low in ("true", "1")
+        else:
+            try:
+                if isinstance(default, int):
+                    out[key] = int(raw)
+                elif isinstance(default, float):
+                    out[key] = float(raw)
+                elif isinstance(default, tuple):
+                    out[key] = tuple(
+                        float(x) if "." in x else int(x)
+                        for x in raw.split(",") if x != "")
+                else:
+                    out[key] = raw
+            except ValueError:
+                raise SystemExit(
+                    f"--set {key}: expected a "
+                    f"{type(default).__name__}, got {raw!r}") from None
+    return out
 
 
 def _resolve_model(args) -> tuple[str, str]:
@@ -129,6 +178,7 @@ def _run(args, multihost: bool) -> int:
                                    ("learning_rate", args.lr),
                                    ("snapshot_dir", args.snapshot_dir))
                  if v is not None}
+    overrides.update(_parse_config_sets(args.config_sets))
     if overrides:
         from theanompi_tpu.rules import resolve_model_class
         import dataclasses

@@ -28,6 +28,7 @@ the dataset, and a config; everything else is inherited.
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 from typing import Any, Iterator
 
@@ -59,7 +60,7 @@ from theanompi_tpu.parallel.mesh import (
     replicate,
 )
 from theanompi_tpu.utils.helper_funcs import (
-    build_sgd_optimizer,
+    build_optimizer,
     load_params_npz,
     save_params_npz,
     scale_lr,
@@ -93,13 +94,25 @@ class ModelConfig:
     batch_size: int = 128
     n_epochs: int = 70
     learning_rate: float = 0.01
+    #: optimizer family (utils.helper_funcs.OPTIMIZERS): 'sgd' is the
+    #: reference recipe; 'lars' is the large-batch ResNet choice,
+    #: 'adamw' the transformer one
+    optimizer: str = "sgd"
     momentum: float = 0.9
     nesterov: bool = False
     weight_decay: float = 1e-4
-    lr_schedule: str = "step"              # 'step' | 'constant' | 'poly'
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    rmsprop_decay: float = 0.9
+    lars_trust_coefficient: float = 0.001
+    lr_schedule: str = "step"       # 'step' | 'constant' | 'poly' | 'cosine'
     lr_decay_epochs: tuple = (40, 60)
     lr_decay_factor: float = 0.1
     lr_poly_power: float = 1.0
+    #: linear warmup over the first N epochs (0 = off), applied before
+    #: the schedule proper — the standard large-batch ramp
+    warmup_epochs: int = 0
     lr_scale_with_workers: str | None = None   # None | 'linear' | 'sqrt'
     exchange_strategy: str = "psum"        # reference names accepted (nccl16...)
     exchange_what: str = "grads"
@@ -253,17 +266,21 @@ class TpuModel:
     # -- optimizer / loss ----------------------------------------------------
 
     def _build_optimizer(self, lr: float) -> optax.GradientTransformation:
+        return build_optimizer(lr, **self._optimizer_kwargs())
+
+    def _optimizer_kwargs(self) -> dict:
         cfg = self.config
-        return build_sgd_optimizer(lr, momentum=cfg.momentum,
-                                   nesterov=cfg.nesterov,
-                                   weight_decay=cfg.weight_decay)
+        return {"optimizer": cfg.optimizer, "momentum": cfg.momentum,
+                "nesterov": cfg.nesterov, "weight_decay": cfg.weight_decay,
+                "beta1": cfg.adam_beta1, "beta2": cfg.adam_beta2,
+                "eps": cfg.adam_eps, "rmsprop_decay": cfg.rmsprop_decay,
+                "lars_trust_coefficient": cfg.lars_trust_coefficient}
 
     def optimizer_hyperparams(self) -> dict:
         """The plain-value description of this model's optimizer — what
-        a remote ASGD service needs to rebuild it (parallel/service.py)."""
-        cfg = self.config
-        return {"learning_rate": self._base_lr, "momentum": cfg.momentum,
-                "nesterov": cfg.nesterov, "weight_decay": cfg.weight_decay}
+        a remote ASGD service needs to rebuild it (parallel/service.py;
+        the keys are ``build_optimizer``'s kwargs)."""
+        return {"learning_rate": self._base_lr, **self._optimizer_kwargs()}
 
     def loss_fn(self, params, model_state, batch, rng):
         """Default: softmax CE + top-1 error.  Override for GANs etc.
@@ -397,16 +414,24 @@ class TpuModel:
         if k > 1:
             host_iter = _stack_host_batches(host_iter, k)
             n_iters -= n_iters % k
-            from jax.sharding import PartitionSpec as P
-
-            from theanompi_tpu.parallel.mesh import AXIS_DATA
-
-            per_step = spec if spec is not None else P(AXIS_DATA)
-            spec = P(None, *per_step)  # leading steps axis is unsharded
+            spec = self.stacked_batch_spec()
         self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh,
                                                   spec=spec)
         self._train_iter = iter(self._train_prefetcher)
         return n_iters
+
+    def stacked_batch_spec(self):
+        """PartitionSpec of a k-stacked batch for ``train_step_multi``:
+        leading steps axis unsharded, per-step axes per
+        ``batch_partition`` — the single source bench.py and
+        ``begin_epoch`` both stage with."""
+        from jax.sharding import PartitionSpec as P
+
+        from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+        per_step = (self.batch_partition if self.batch_partition
+                    is not None else P(AXIS_DATA))
+        return P(None, *per_step)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -510,16 +535,24 @@ class TpuModel:
         return {k: v / len(pending) for k, v in sums.items()}
 
     def adjust_hyperp(self, epoch: int) -> float:
-        """Per-epoch LR schedule (the reference's step/poly decay)."""
+        """Per-epoch LR schedule (the reference's step/poly decay, plus
+        cosine and the large-batch linear warmup ramp)."""
         cfg = self.config
-        if cfg.lr_schedule == "constant":
+        if cfg.warmup_epochs and epoch < cfg.warmup_epochs:
+            lr = self._base_lr * (epoch + 1) / cfg.warmup_epochs
+        elif cfg.lr_schedule == "constant":
             lr = self._base_lr
         elif cfg.lr_schedule == "step":
             k = sum(1 for e in cfg.lr_decay_epochs if epoch >= e)
             lr = self._base_lr * (cfg.lr_decay_factor ** k)
-        elif cfg.lr_schedule == "poly":
-            frac = min(epoch / max(cfg.n_epochs, 1), 1.0)
-            lr = self._base_lr * (1.0 - frac) ** cfg.lr_poly_power
+        elif cfg.lr_schedule in ("poly", "cosine"):
+            # decay spans the post-warmup epochs
+            span = max(cfg.n_epochs - cfg.warmup_epochs, 1)
+            frac = min((epoch - cfg.warmup_epochs) / span, 1.0)
+            if cfg.lr_schedule == "poly":
+                lr = self._base_lr * (1.0 - frac) ** cfg.lr_poly_power
+            else:
+                lr = self._base_lr * 0.5 * (1.0 + math.cos(math.pi * frac))
         else:
             raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
         self.state = self.state.replace(

@@ -52,7 +52,7 @@ def _np(tree: PyTree) -> PyTree:
     return jax.tree.map(np.asarray, tree)
 
 
-from theanompi_tpu.utils.helper_funcs import build_sgd_optimizer
+from theanompi_tpu.utils.helper_funcs import build_optimizer
 
 
 # ---------------------------------------------------------------------------
@@ -103,7 +103,7 @@ class ParamService:
                   opt_state: PyTree | None, session_id: str):
         with self._init_lock:
             if self._fresh("asgd", session_id):
-                tx = build_sgd_optimizer(**opt_cfg)
+                tx = build_optimizer(**opt_cfg)
                 store = self._classes["asgd"](params, tx)
                 if opt_state is not None:  # resume
                     store.set_opt_state(opt_state)

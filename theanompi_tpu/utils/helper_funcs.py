@@ -37,23 +37,66 @@ def scale_lr(lr: float, size: int, mode: str = "linear") -> float:
     raise ValueError(f"unknown lr scaling mode {mode!r}")
 
 
-def build_sgd_optimizer(learning_rate: float, momentum: float = 0.0,
-                        nesterov: bool = False, weight_decay: float = 0.0):
-    """The framework's standard SGD chain (decoupled weight decay +
-    momentum SGD, lr mutable via inject_hyperparams) from plain
-    hyperparams — shared by TpuModel and the remote ASGD service, which
-    must rebuild the worker's optimizer from an init message (optax
-    transforms hold closures and do not pickle)."""
+#: optimizer families ``build_optimizer`` knows how to assemble.  The
+#: reference era was SGD+momentum only (its layers lib built momentum
+#: update rules by hand); the zoo adds the families large-batch TPU
+#: recipes actually use (LARS for big-batch ResNet, AdamW for
+#: transformers) — all lr-mutable via inject_hyperparams so
+#: ``adjust_hyperp``/``set_learning_rate`` work uniformly.
+OPTIMIZERS = ("sgd", "adam", "adamw", "rmsprop", "lars")
+
+
+def build_optimizer(learning_rate: float, optimizer: str = "sgd",
+                    momentum: float = 0.0, nesterov: bool = False,
+                    weight_decay: float = 0.0, beta1: float = 0.9,
+                    beta2: float = 0.999, eps: float = 1e-8,
+                    rmsprop_decay: float = 0.9,
+                    lars_trust_coefficient: float = 0.001):
+    """Build the framework's optimizer chain from plain hyperparams —
+    shared by TpuModel and the remote parameter service, which must
+    rebuild a worker's optimizer from an init message (optax transforms
+    hold closures and do not pickle, so the wire format is this kwargs
+    dict; see ``TpuModel.optimizer_hyperparams``).
+
+    Weight decay is decoupled (added to grads pre-update) for sgd /
+    adam / rmsprop; adamw and lars apply their own internal decay.
+    """
+    if optimizer not in OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {optimizer!r}; "
+                         f"choose from {OPTIMIZERS}")
 
     def make(learning_rate):
         parts = []
-        if weight_decay:
+        if weight_decay and optimizer in ("sgd", "adam", "rmsprop"):
             parts.append(optax.add_decayed_weights(weight_decay))
-        parts.append(optax.sgd(learning_rate, momentum=momentum or None,
-                               nesterov=nesterov))
+        if optimizer == "sgd":
+            parts.append(optax.sgd(learning_rate, momentum=momentum or None,
+                                   nesterov=nesterov))
+        elif optimizer == "adam":
+            parts.append(optax.adam(learning_rate, b1=beta1, b2=beta2,
+                                    eps=eps))
+        elif optimizer == "adamw":
+            parts.append(optax.adamw(learning_rate, b1=beta1, b2=beta2,
+                                     eps=eps, weight_decay=weight_decay))
+        elif optimizer == "rmsprop":
+            parts.append(optax.rmsprop(learning_rate, decay=rmsprop_decay,
+                                       eps=eps, momentum=momentum or None))
+        elif optimizer == "lars":
+            parts.append(optax.lars(
+                learning_rate, weight_decay=weight_decay,
+                trust_coefficient=lars_trust_coefficient,
+                momentum=momentum, nesterov=nesterov))
         return optax.chain(*parts)
 
     return optax.inject_hyperparams(make)(learning_rate=learning_rate)
+
+
+def build_sgd_optimizer(learning_rate: float, momentum: float = 0.0,
+                        nesterov: bool = False, weight_decay: float = 0.0):
+    """Back-compat alias: the original SGD-only builder."""
+    return build_optimizer(learning_rate, optimizer="sgd",
+                           momentum=momentum, nesterov=nesterov,
+                           weight_decay=weight_decay)
 
 
 def set_learning_rate(opt_state: PyTree, lr: float) -> PyTree:

@@ -41,6 +41,8 @@ def load_events(path: str) -> list[dict]:
 
 
 CATEGORIES = (
+    # "cast" must precede "conv": substring "conv" matches "convert"
+    ("cast", ("convert",)),
     ("conv", ("conv",)),
     ("matmul", ("dot", "einsum", "matmul")),
     ("collective", ("all-reduce", "all-gather", "all-to-all",
