@@ -182,3 +182,47 @@ def test_graft_entry_dryrun():
     from __graft_entry__ import dryrun_multichip
 
     dryrun_multichip(8)
+
+
+class TestS2dStem:
+    def test_space_to_depth_layout(self):
+        import jax.numpy as jnp
+
+        from theanompi_tpu.models.resnet50 import space_to_depth
+
+        x = jnp.arange(2 * 4 * 4 * 3).reshape(2, 4, 4, 3)
+        y = space_to_depth(x, 2)
+        assert y.shape == (2, 2, 2, 12)
+        # block (0,0) channels = pixels (0,0),(0,1),(1,0),(1,1) in
+        # (row-offset, col-offset, channel) order
+        np.testing.assert_array_equal(
+            np.asarray(y[0, 0, 0]),
+            np.concatenate([np.asarray(x[0, 0, 0]), np.asarray(x[0, 0, 1]),
+                            np.asarray(x[0, 1, 0]), np.asarray(x[0, 1, 1])]))
+
+    def test_s2d_stem_exactly_matches_conv7(self):
+        """The s2d stem is a re-parameterization, not an approximation:
+        transplanting a trained 7x7 kernel through
+        s2d_stem_kernel_from_conv7 reproduces the conv7 network's
+        output on random input."""
+        import jax
+        import jax.numpy as jnp
+
+        from theanompi_tpu.models.resnet50 import (
+            ResNet,
+            s2d_stem_kernel_from_conv7,
+        )
+
+        kw = dict(stage_sizes=(1,), width=8, n_classes=4)
+        m7 = ResNet(stem="conv7", **kw)
+        ms = ResNet(stem="s2d", **kw)
+        x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+        v7 = m7.init(jax.random.key(1), x, train=True)
+        vs = jax.tree.map(jnp.copy, v7)
+        vs["params"]["stem_conv"]["Conv_0"]["kernel"] = (
+            s2d_stem_kernel_from_conv7(
+                v7["params"]["stem_conv"]["Conv_0"]["kernel"]))
+        out7 = m7.apply(v7, x, train=False)
+        outs = ms.apply(vs, x, train=False)
+        np.testing.assert_allclose(np.asarray(outs), np.asarray(out7),
+                                   rtol=1e-5, atol=1e-5)

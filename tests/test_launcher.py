@@ -135,6 +135,9 @@ def test_set_overrides_typed():
     ("no_such_field=1", "unknown ModelConfig field"),
     ("warmup_epochs", "expects K=V"),
     ("nesterov=maybe", "expected a bool"),
+    ("warmup_epochs=five", "expected a int"),
+    ("batch_size=none", "expected a int"),   # none only for nullable
+    ("nesterov=none", "expected a bool"),
 ])
 def test_set_overrides_rejected(bad, msg):
     from theanompi_tpu.launcher import _parse_config_sets

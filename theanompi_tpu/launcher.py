@@ -117,7 +117,8 @@ def _parse_config_sets(pairs: list[str]) -> dict:
                              f"valid: {', '.join(sorted(fields))}")
         default = fields[key].default
         low = raw.lower()
-        if low in ("none", "null"):
+        if low in ("none", "null") and default is None:
+            # only nullable fields (declared default None) accept it
             out[key] = None
         elif isinstance(default, bool):
             if low not in ("true", "false", "1", "0"):

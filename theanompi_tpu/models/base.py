@@ -122,6 +122,10 @@ class ModelConfig:
     #: (the reference's loader semantics).  Honored by the ImageNet
     #: model family's build_data.
     augment_on_device: bool = True
+    #: ResNet stem flavor: 'conv7' (reference geometry) or 's2d'
+    #: (exact space-to-depth re-parameterization — the TPU-friendly
+    #: shape for the C=3 stem conv; models/resnet50.py)
+    resnet_stem: str = "conv7"
     #: scan this many training iterations into one device program
     #: (parallel/bsp.py make_bsp_multi_step) — amortizes per-dispatch
     #: tunnel overhead; 1 = one program per batch (reference cadence)

@@ -58,19 +58,65 @@ class BottleneckBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+def space_to_depth(x, block: int = 2):
+    """(N, H, W, C) -> (N, H/b, W/b, b*b*C) — pixel-block channels in
+    (row-offset, col-offset, channel) order, matching
+    ``s2d_stem_kernel_from_conv7``."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+def s2d_stem_kernel_from_conv7(w7):
+    """Exact re-parameterization of a 7x7/stride-2 stem kernel as the
+    4x4/stride-1 kernel over the 2x2 space-to-depth input: zero-pad
+    the taps 7->8 at the leading edge (tap index p = original + 1, so
+    p = 2q + a with block tap q and within-block offset a), then fold
+    the offsets into the input-channel dim.  Used by the equivalence
+    test; training from scratch just initializes the 4x4 kernel."""
+    kh, kw, c, o = w7.shape
+    assert (kh, kw) == (7, 7)
+    w8 = jnp.zeros((8, 8, c, o), w7.dtype).at[1:, 1:].set(w7)
+    w8 = w8.reshape(4, 2, 4, 2, c, o)           # (q, a, p, b, c, o)
+    return w8.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c, o)
+
+
 class ResNet(nn.Module):
-    """Generic bottleneck ResNet (50 = (3,4,6,3))."""
+    """Generic bottleneck ResNet (50 = (3,4,6,3)).
+
+    ``stem='s2d'`` replaces the 7x7/stride-2 stem conv with the exact
+    4x4/stride-1 conv over a 2x2 space-to-depth input (12 channels
+    instead of 3): the C=3 conv is the one shape in the network the
+    MXU cannot pack lanes for, and this is the standard TPU fix for
+    it.  Identical function class (see s2d_stem_kernel_from_conv7 +
+    tests); opt-in until on-chip profiling decides the default.
+    """
 
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     width: int = 64
     n_classes: int = 1000
     dtype: jnp.dtype = jnp.float32
+    stem: str = "conv7"          # 'conv7' | 's2d'
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        x = L.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                   use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        if self.stem == "s2d":
+            if x.shape[1] % 2 or x.shape[2] % 2:
+                raise ValueError("stem='s2d' needs even spatial dims, "
+                                 f"got {x.shape}")
+            x = space_to_depth(x, 2)
+            # block rows i-2..i+1 of the s2d image -> pad (2, 1)
+            x = L.Conv(self.width, (4, 4), strides=(1, 1),
+                       padding=[(2, 1), (2, 1)], use_bias=False,
+                       dtype=self.dtype, name="stem_conv")(x)
+        elif self.stem == "conv7":
+            x = L.Conv(self.width, (7, 7), strides=(2, 2),
+                       padding=[(3, 3), (3, 3)], use_bias=False,
+                       dtype=self.dtype, name="stem_conv")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype, name="stem_bn")(x)
         x = nn.relu(x)
@@ -111,7 +157,8 @@ class ResNet50(TpuModel):
     def build_module(self) -> nn.Module:
         return ResNet(stage_sizes=self.stage_sizes,
                       n_classes=self.data.n_classes,
-                      dtype=self._compute_dtype())
+                      dtype=self._compute_dtype(),
+                      stem=self.config.resnet_stem)
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir,

@@ -58,8 +58,10 @@ def build_optimizer(learning_rate: float, optimizer: str = "sgd",
     hold closures and do not pickle, so the wire format is this kwargs
     dict; see ``TpuModel.optimizer_hyperparams``).
 
-    Weight decay is decoupled (added to grads pre-update) for sgd /
-    adam / rmsprop; adamw and lars apply their own internal decay.
+    Weight decay for sgd / adam / rmsprop is classic L2 added to the
+    grads pre-update (coupled — for adaptive optimizers it rides
+    through the normalization); adamw and lars apply their own
+    *decoupled* decay directly to the params.
     """
     if optimizer not in OPTIMIZERS:
         raise ValueError(f"unknown optimizer {optimizer!r}; "
