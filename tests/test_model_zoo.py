@@ -168,3 +168,53 @@ class TestZooVariants:
         cfg = ModelConfig(batch_size=2, n_epochs=1, compute_dtype="float32",
                           print_freq=100)
         run_short_training(TinyR101(config=cfg, mesh=mesh8), n_iters=2)
+
+
+class TestResNet50LargeBatch:
+    def test_zoo_resolution_and_recipe(self):
+        from theanompi_tpu.models import MODEL_ZOO
+        from theanompi_tpu.models.model_zoo import ResNet50_LargeBatch
+
+        assert MODEL_ZOO["resnet50_large"] == (
+            "theanompi_tpu.models.model_zoo", "ResNet50_LargeBatch")
+        cfg = ResNet50_LargeBatch.default_config()
+        assert (cfg.optimizer, cfg.lr_schedule) == ("lars", "cosine")
+        assert cfg.warmup_epochs == 5 and cfg.resnet_stem == "s2d"
+        assert cfg.batch_size == 256 and cfg.compute_dtype == "bfloat16"
+
+    def test_lars_s2d_trains_width_scaled(self, mesh8):
+        """The recipe's moving parts (LARS + warmup + s2d stem) drive
+        the BSP spine together on a width-scaled network."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from theanompi_tpu.models.model_zoo import ResNet50_LargeBatch
+        from theanompi_tpu.models.resnet50 import ResNet
+        from theanompi_tpu.utils.recorder import Recorder
+
+        class Tiny(ResNet50_LargeBatch):
+            def build_data(self):
+                return tiny_imagenet(16, synthetic_store=20)
+
+            def build_module(self):
+                return ResNet(stage_sizes=(1, 1), width=8,
+                              n_classes=self.data.n_classes,
+                              dtype=jnp.float32,
+                              stem=self.config.resnet_stem)
+
+        cfg = dataclasses.replace(
+            ResNet50_LargeBatch.default_config(), batch_size=2,
+            n_epochs=2, compute_dtype="float32", print_freq=0,
+            learning_rate=0.1)
+        m = Tiny(config=cfg, mesh=mesh8, verbose=False)
+        # sqrt worker scaling (8 data shards) then the 5-epoch warmup
+        assert m.adjust_hyperp(0) == pytest.approx(0.1 * 8 ** 0.5 / 5)
+        m.compile_iter_fns("avg")
+        rec = Recorder(rank=0, size=8, print_freq=0)
+        m.begin_epoch(0)
+        for i in range(3):
+            m.train_iter(i, rec)
+        m._flush_metrics(rec)
+        assert np.isfinite(rec.train_losses).all()
+        m.cleanup()

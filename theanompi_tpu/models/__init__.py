@@ -24,6 +24,9 @@ MODEL_ZOO = {
     "vgg19": ("theanompi_tpu.models.model_zoo", "VGG19"),
     "resnet101": ("theanompi_tpu.models.model_zoo", "ResNet101"),
     "resnet152": ("theanompi_tpu.models.model_zoo", "ResNet152"),
+    # the modern large-batch recipe (LARS + warmup/cosine + s2d stem)
+    "resnet50_large": ("theanompi_tpu.models.model_zoo",
+                       "ResNet50_LargeBatch"),
 }
 
 __all__ = ["MODEL_ZOO"]

@@ -32,3 +32,38 @@ class ResNet101(ResNet50):
 class ResNet152(ResNet101):
     name = "resnet152"
     stage_sizes = (3, 8, 36, 3)
+
+
+class ResNet50_LargeBatch(ResNet50):
+    """The modern large-batch TPU recipe over the same network: LARS +
+    linear warmup + cosine decay (Goyal-style ramp, You-style layerwise
+    trust ratios), per-chip batch 256, bf16 compute, space-to-depth
+    stem.  The reference era scaled its SGD LR linearly with workers
+    (SURVEY.md §2.7 scale_lr); this is the recipe that replaced it when
+    global batches outgrew plain momentum."""
+
+    name = "resnet50_large"
+
+    @classmethod
+    def default_config(cls):
+        from theanompi_tpu.models.base import ModelConfig
+
+        return ModelConfig(
+            batch_size=256,
+            # per-shard master LR; sqrt scaling with the data-shard
+            # count keeps the LARS LR in its working range at every
+            # mesh size (0.7 on 1 chip -> ~4 at 32 shards / 8k global
+            # batch, the regime the published LARS recipes tune for)
+            learning_rate=0.7,
+            lr_scale_with_workers="sqrt",
+            n_epochs=90,
+            optimizer="lars",
+            momentum=0.9,
+            weight_decay=1e-4,
+            lr_schedule="cosine",
+            warmup_epochs=5,
+            compute_dtype="bfloat16",
+            resnet_stem="s2d",
+            track_top5=True,
+            print_freq=20,
+        )
