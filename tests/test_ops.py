@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from theanompi_tpu.ops import lrn
 
@@ -89,3 +90,106 @@ class TestLRNPallas:
         import pytest
         with pytest.raises(ValueError):
             lrn(jnp.ones((1, 1, 1, 4)), impl="cuda")
+
+
+class TestFusedAttention:
+    """ops/attention.py Pallas kernel (interpret mode on CPU) vs the
+    parallel/sequence.py oracle."""
+
+    def _rand(self, b=2, tq=16, tk=16, h=2, d=8, seed=0):
+        import jax
+
+        ks = jax.random.split(jax.random.key(seed), 3)
+        q = jax.random.normal(ks[0], (b, tq, h, d))
+        k = jax.random.normal(ks[1], (b, tk, h, d))
+        v = jax.random.normal(ks[2], (b, tk, h, d))
+        return q, k, v
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_oracle(self, causal):
+        from theanompi_tpu.ops.attention import fused_attention
+        from theanompi_tpu.parallel.sequence import attention_reference
+
+        q, k, v = self._rand()
+        got = fused_attention(q, k, v, causal=causal, impl="pallas")
+        want = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_global_positions_match_oracle(self):
+        import jax.numpy as jnp
+
+        from theanompi_tpu.ops.attention import fused_attention
+        from theanompi_tpu.parallel.sequence import _attention_positions
+
+        q, k, v = self._rand(tq=8, tk=24)
+        q_pos = 16 + jnp.arange(8)       # a later shard attends back
+        k_pos = jnp.arange(24)
+        got = fused_attention(q, k, v, q_pos=q_pos, k_pos=k_pos,
+                              causal=True, impl="pallas")
+        want = _attention_positions(q, k, v, q_pos, k_pos,
+                                    q.shape[-1] ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_oracle(self):
+        import jax
+
+        from theanompi_tpu.ops.attention import fused_attention
+        from theanompi_tpu.parallel.sequence import attention_reference
+
+        q, k, v = self._rand(tq=12, tk=12)
+
+        def loss(fn, q, k, v):
+            return (fn(q, k, v) ** 2).sum()
+
+        g_got = jax.grad(lambda *a: loss(
+            lambda q, k, v: fused_attention(q, k, v, causal=True,
+                                            impl="pallas"), *a),
+            argnums=(0, 1, 2))(q, k, v)
+        g_want = jax.grad(lambda *a: loss(
+            lambda q, k, v: attention_reference(q, k, v, causal=True),
+            *a), argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=5e-5, atol=5e-5)
+
+    def test_q_blocking_non_divisible(self, monkeypatch):
+        import theanompi_tpu.ops.attention as A
+
+        monkeypatch.setattr(A, "_Q_BLOCK", 8)
+        q, k, v = self._rand(tq=20, tk=20)   # 20 = 2 full blocks + 4
+        got = A.fused_attention(q, k, v, causal=True, impl="pallas")
+        from theanompi_tpu.parallel.sequence import attention_reference
+
+        want = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_auto_falls_back_off_tpu_and_on_oversize(self):
+        import jax.numpy as jnp
+
+        import theanompi_tpu.ops.attention as A
+
+        q, k, v = self._rand(tq=4, tk=4)
+        assert A._resolve_impl("auto", q, k) == "xla"  # cpu backend
+        # oversize K/V: auto must refuse pallas even on TPU
+        big = jnp.zeros((1, 200_000, 1, 64))
+        assert A._resolve_impl("auto", big, big) == "xla"
+        with pytest.raises(ValueError, match="unknown attention impl"):
+            A._resolve_impl("flash", q, k)
+
+    def test_bf16_inputs(self):
+        import jax.numpy as jnp
+
+        from theanompi_tpu.ops.attention import fused_attention
+        from theanompi_tpu.parallel.sequence import attention_reference
+
+        q, k, v = self._rand()
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        got = fused_attention(qb, kb, vb, causal=True, impl="pallas")
+        assert got.dtype == jnp.bfloat16
+        want = attention_reference(qb, kb, vb, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2)

@@ -29,8 +29,8 @@ from theanompi_tpu.parallel.mesh import (
     AXIS_PIPE,
     AXIS_SEQ,
 )
+from theanompi_tpu.ops.attention import fused_attention
 from theanompi_tpu.parallel.sequence import (
-    attention_reference,
     sequence_attention,
 )
 
@@ -72,7 +72,9 @@ class Block(nn.Module):
             o = sequence_attention(q, k, v, axis_name=seq_axis, causal=True,
                                    strategy=self.sp_strategy)
         else:
-            o = attention_reference(q, k, v, causal=True)
+            # full local attention: the fused Pallas kernel on TPU
+            # (ops/attention.py; XLA oracle elsewhere/oversize)
+            o = fused_attention(q, k, v, causal=True)
         o = o.reshape((b, t, self.d_model))
         x = x + nn.Dense(self.d_model, use_bias=False,
                          kernel_init=L.xavier_init(), dtype=self.dtype,
@@ -464,9 +466,9 @@ class AttnBlock(nn.Module):
             self.d_model, use_bias=False, kernel_init=L.xavier_init(),
             dtype=self.dtype, name=name)(h)
         shape = (b, t, self.n_heads, d_head)
-        o = attention_reference(proj("q_proj").reshape(shape),
-                                proj("k_proj").reshape(shape),
-                                proj("v_proj").reshape(shape), causal=True)
+        o = fused_attention(proj("q_proj").reshape(shape),
+                            proj("k_proj").reshape(shape),
+                            proj("v_proj").reshape(shape), causal=True)
         o = o.reshape((b, t, self.d_model))
         return x + nn.Dense(self.d_model, use_bias=False,
                             kernel_init=L.xavier_init(), dtype=self.dtype,

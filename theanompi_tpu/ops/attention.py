@@ -1,0 +1,200 @@
+"""Fused softmax attention as a Pallas TPU kernel.
+
+The transformer family's hot op (beyond-parity surface — the reference
+predates attention; its analogue is routing conv/LRN to cuDNN,
+SURVEY.md §2.12).  The kernel computes one Q block's full attention in
+VMEM — scores, causal/position mask, row softmax, and the PV matmul —
+in a single pass per (batch*head, q-block) grid cell, so the (Tq, Tk)
+score matrix never round-trips HBM the way the composed XLA form's
+does.  Softmax statistics are computed in fp32 regardless of the
+compute dtype.
+
+Scope notes:
+
+* K/V for one (batch, head) must fit VMEM alongside one fp32 score
+  block (checked; oversize shapes fall back to the XLA path) — local
+  shard lengths up to a few thousand, which is the regime this
+  framework runs attention at: GLOBAL long context is the ring/
+  Ulysses layer's job (parallel/sequence.py), and what each device
+  sees locally is exactly this kernel's shape.
+* Backward is the standard analytic attention VJP composed from XLA
+  einsums (recompute-from-inputs, no residual score matrix) — fusing
+  the bwd too is a further step, not a correctness need.
+* ``impl='auto'``: Pallas on TPU, XLA elsewhere; force with
+  ``THEANOMPI_TPU_ATTN_IMPL=pallas|xla`` (interpret mode makes the
+  Pallas path unit-testable on the CPU mesh, tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# large-negative mask value: finite so softmax/online-softmax
+# accumulators never produce inf-inf=nan; exp(-1e30 - m) underflows to
+# exactly 0 once any real score is seen, wiping masked contributions.
+# The single source — parallel/sequence.py imports it.
+_MASK_NEG = -1e30
+#: per-(batch*head) VMEM budget for K + V + one fp32 score block
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+_Q_BLOCK = 256
+
+
+def block_scores(q, k, scale):
+    """q (B,Tq,H,D) x k (B,Tk,H,D) -> (B,H,Tq,Tk); fp32 accumulation.
+    Shared with parallel/sequence.py's ring/oracle forms."""
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def causal_mask(q_pos, k_pos):
+    return q_pos[:, None] >= k_pos[None, :]          # (Tq, Tk)
+
+
+def _kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, *, scale,
+            causal):
+    q = q_ref[0]                                      # (TQ, D)
+    k = k_ref[0]                                      # (TK, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # (TQ, TK)
+    if causal:
+        mask = qpos_ref[:] >= kpos_ref[:]             # (TQ,1)>=(1,TK)
+        s = jnp.where(mask, s, _MASK_NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+
+
+def _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
+                      interpret: bool):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bh = b * h
+
+    def fold(x):                                      # (B,T,H,D)->(BH,T,D)
+        return x.transpose(0, 2, 1, 3).reshape(bh, x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    qp = q_pos.astype(jnp.int32).reshape(tq, 1)
+    kp = k_pos.astype(jnp.int32).reshape(1, tk)
+
+    tq_blk = min(_Q_BLOCK, tq)
+    grid = (bh, pl.cdiv(tq, tq_blk))
+    kern = functools.partial(_kernel, scale=scale, causal=causal)
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tq_blk, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq_blk, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tq_blk, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, qp, kp)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+def _xla_attention(q, k, v, q_pos, k_pos, scale, causal):
+    """The composed-XLA fallback (same primitives as the oracle)."""
+    s = block_scores(q, k, scale)
+    if causal:
+        s = jnp.where(causal_mask(q_pos, k_pos)[None, None], s, _MASK_NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _fits_vmem(tq, tk, d, dtype) -> bool:
+    itemsize = jnp.dtype(dtype).itemsize
+    tq_blk = min(_Q_BLOCK, tq)
+    need = (2 * tk * d * itemsize          # K + V
+            + tq_blk * d * itemsize        # Q block
+            + 2 * tq_blk * tk * 4)         # fp32 scores + exp
+    return need <= _VMEM_BUDGET_BYTES
+
+
+def _resolve_impl(impl: str | None, q, k) -> str:
+    impl = impl or os.environ.get("THEANOMPI_TPU_ATTN_IMPL", "auto")
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if impl == "auto":
+        b, tq, h, d = q.shape
+        if not _fits_vmem(tq, k.shape[1], d, q.dtype):
+            return "xla"
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return impl
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _fused(q, k, v, q_pos, k_pos, scale, causal, interpret):
+    return _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
+                             interpret)
+
+
+def _fused_fwd(q, k, v, q_pos, k_pos, scale, causal, interpret):
+    out = _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
+                            interpret)
+    return out, (q, k, v, q_pos, k_pos)
+
+
+def _fused_bwd(scale, causal, interpret, res, g):
+    """Analytic attention VJP (recompute p from inputs):
+    dv = p^T g;  ds = p * (dp - rowsum(dp*p)),  dp = g v^T;
+    dq = ds k * scale;  dk = ds^T q * scale."""
+    q, k, v, q_pos, k_pos = res
+    s = block_scores(q, k, scale)
+    if causal:
+        s = jnp.where(causal_mask(q_pos, k_pos)[None, None], s, _MASK_NEG)
+    p = jax.nn.softmax(s, axis=-1)                       # fp32
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, g32).astype(v.dtype)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", g32, v.astype(jnp.float32))
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = (jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32))
+          * scale).astype(q.dtype)
+    dk = (jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+          * scale).astype(k.dtype)
+    return dq, dk, dv, None, None
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_attention(q, k, v, q_pos=None, k_pos=None,
+                    causal: bool = False, scale: float | None = None,
+                    impl: str | None = None):
+    """Softmax attention, fused on TPU.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D); optional global positions
+    (Tq,)/(Tk,) for the causal mask (default: local aranges).  Returns
+    (B, Tq, H, D) in q.dtype.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if q_pos is None:
+        q_pos = jnp.arange(q.shape[1])
+    if k_pos is None:
+        k_pos = jnp.arange(k.shape[1])
+    resolved = _resolve_impl(impl, q, k)
+    if resolved == "xla":
+        return _xla_attention(q, k, v, q_pos, k_pos, scale, causal)
+    interpret = jax.default_backend() != "tpu"
+    return _fused(q, k, v, q_pos, k_pos, scale, causal, interpret)

@@ -35,22 +35,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from theanompi_tpu.ops.attention import (
+    _MASK_NEG,
+    block_scores as _block_scores,
+    causal_mask as _causal_mask,
+    fused_attention,
+)
 from theanompi_tpu.parallel.mesh import AXIS_SEQ
-
-# large-negative mask value: finite so the online-softmax accumulator
-# never produces inf-inf=nan; exp(-1e30 - m) underflows to exactly 0
-# once any real score is seen, wiping masked contributions
-_MASK_NEG = -1e30
-
-
-def _block_scores(q, k, scale):
-    # q (B,Tq,H,D) x k (B,Tk,H,D) -> (B,H,Tq,Tk); fp32 accumulation
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                      preferred_element_type=jnp.float32) * scale
-
-
-def _causal_mask(q_pos, k_pos):
-    return q_pos[:, None] >= k_pos[None, :]          # (Tq, Tk)
 
 
 def attention_reference(q, k, v, causal: bool = False,
@@ -131,12 +122,12 @@ def allgather_attention(q, k, v, axis_name: str = AXIS_SEQ,
     k_full = lax.all_gather(k, axis_name, axis=1, tiled=True)
     v_full = lax.all_gather(v, axis_name, axis=1, tiled=True)
     if not causal:
-        s = _block_scores(q, k_full, scale)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v_full)
+        return fused_attention(q, k_full, v_full, causal=False,
+                               scale=scale)
     q_pos = idx * t_local + jnp.arange(t_local)
     k_pos = jnp.arange(n * t_local)
-    return _attention_positions(q, k_full, v_full, q_pos, k_pos, scale)
+    return fused_attention(q, k_full, v_full, q_pos=q_pos, k_pos=k_pos,
+                           causal=True, scale=scale)
 
 
 def ulysses_attention(q, k, v, axis_name: str = AXIS_SEQ,
@@ -159,7 +150,7 @@ def ulysses_attention(q, k, v, axis_name: str = AXIS_SEQ,
                               tiled=True)
 
     qh, kh, vh = to_headshard(q), to_headshard(k), to_headshard(v)
-    out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    out = fused_attention(qh, kh, vh, causal=causal, scale=scale)
     return to_timeshard(out)
 
 
