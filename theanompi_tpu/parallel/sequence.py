@@ -59,11 +59,12 @@ def attention_reference(q, k, v, causal: bool = False,
 
 
 def _attention_positions(q, k, v, q_pos, k_pos, scale):
-    """Masked attention with explicit global positions (causal)."""
-    s = _block_scores(q, k, scale)
-    s = jnp.where(_causal_mask(q_pos, k_pos)[None, None], s, _MASK_NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    """Masked attention with explicit global positions (causal) — the
+    tests' position-mask oracle; delegates to the one composed-XLA
+    implementation (ops/attention.py)."""
+    from theanompi_tpu.ops.attention import _xla_attention
+
+    return _xla_attention(q, k, v, q_pos, k_pos, scale, causal=True)
 
 
 def ring_attention(q, k, v, axis_name: str = AXIS_SEQ,

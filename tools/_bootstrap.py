@@ -1,0 +1,17 @@
+"""Shared tool bootstrap: make JAX_PLATFORMS effective.
+
+This environment pre-registers the experimental axon TPU plugin via
+sitecustomize, which ignores the JAX_PLATFORMS env var on its own — a
+tool meant to run on CPU would silently touch (and possibly wedge) the
+TPU tunnel.  Import this module AFTER putting the repo root on
+sys.path and BEFORE first backend use.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

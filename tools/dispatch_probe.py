@@ -23,6 +23,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import _bootstrap  # noqa: F401  (makes JAX_PLATFORMS effective)
 import jax
 import jax.numpy as jnp
 import numpy as np
