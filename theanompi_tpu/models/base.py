@@ -126,6 +126,11 @@ class ModelConfig:
     #: (exact space-to-depth re-parameterization — the TPU-friendly
     #: shape for the C=3 stem conv; models/resnet50.py)
     resnet_stem: str = "conv7"
+    #: rematerialize transformer blocks in the backward pass
+    #: (jax.checkpoint): activations are recomputed instead of stored,
+    #: trading ~1/3 more FLOPs for O(n_layers) less activation HBM —
+    #: the knob that lets long-context training fit
+    remat: bool = False
     #: scan this many training iterations into one device program
     #: (parallel/bsp.py make_bsp_multi_step) — amortizes per-dispatch
     #: tunnel overhead; 1 = one program per batch (reference cadence)

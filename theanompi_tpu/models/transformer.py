@@ -105,6 +105,9 @@ class TransformerLMNet(nn.Module):
     max_len: int = 2048
     sp_strategy: str = "ring"
     dtype: jnp.dtype = jnp.float32
+    #: jax.checkpoint each block: recompute activations in the
+    #: backward instead of storing them (ModelConfig.remat)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,
@@ -118,9 +121,17 @@ class TransformerLMNet(nn.Module):
                              (self.max_len, self.d_model))
         x = x + lax.dynamic_slice_in_dim(pos_emb, offset, t_local)[None]
         x = x.astype(self.dtype)
-        for _ in range(self.n_layers):
-            x = Block(self.d_model, self.n_heads, self.d_ff,
-                      self.sp_strategy, self.dtype)(x, seq_axis=seq_axis)
+        # static_argnums counts the bound method's args with the module
+        # at 0, so seq_axis (a mesh-axis NAME, not data) is arg 2.
+        # Explicit names pin the param tree to the non-remat layout
+        # (nn.remat's class rename would otherwise key params under
+        # CheckpointBlock_i, breaking snapshots and the TP specs).
+        block_cls = (nn.remat(Block, static_argnums=(2,))
+                     if self.remat else Block)
+        for i in range(self.n_layers):
+            x = block_cls(self.d_model, self.n_heads, self.d_ff,
+                          self.sp_strategy, self.dtype,
+                          name=f"Block_{i}")(x, seq_axis)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab, kernel_init=L.xavier_init(),
                           dtype=self.dtype)(x)
@@ -170,7 +181,7 @@ class TransformerLM(TpuModel):
             vocab=c["vocab"], n_layers=c["n_layers"], d_model=c["d_model"],
             n_heads=c["n_heads"], d_ff=4 * c["d_model"],
             max_len=max(2048, c["seq_len"]), sp_strategy=self.sp_strategy,
-            dtype=self._compute_dtype())
+            dtype=self._compute_dtype(), remat=self.config.remat)
 
     # -- (data x seq) SPMD wiring -------------------------------------------
 
