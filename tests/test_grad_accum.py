@@ -1,0 +1,137 @@
+"""Gradient accumulation (parallel/bsp.py make_bsp_accum_step +
+ModelConfig.grad_accum_steps): a microbatches -> one update, exactly
+the big-batch gradient."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.parallel.bsp import (
+    TrainState,
+    make_bsp_accum_step,
+    make_bsp_train_step,
+)
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+from theanompi_tpu.parallel.mesh import data_mesh, shard_batch
+from theanompi_tpu.utils.helper_funcs import build_sgd_optimizer
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def _linreg_loss(params, model_state, batch, rng):
+    x, y = batch
+    pred = x @ params["w"]
+    loss = jnp.mean((pred - y) ** 2)
+    return loss, (model_state, {"loss": loss, "error": loss})
+
+
+def _setup(mesh):
+    tx = build_sgd_optimizer(0.05, momentum=0.9)
+    params = {"w": jnp.arange(4, dtype=jnp.float32)}
+    state = TrainState.create(params, tx)
+    rng_np = np.random.default_rng(0)
+    x = rng_np.standard_normal((64, 4)).astype(np.float32)
+    y = (x @ np.arange(4.0, 8.0)).astype(np.float32)
+    return tx, state, x, y
+
+
+def test_accum_matches_big_batch(mesh8):
+    """4 microbatches of 16 == one batch of 64 (same update), because
+    the loss is a per-microbatch mean and grads are averaged."""
+    from jax.sharding import PartitionSpec as P
+
+    from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+    tx, state0, x, y = _setup(mesh8)
+    rng = jax.random.key(3)
+
+    big = make_bsp_train_step(_linreg_loss, tx, mesh8, donate=False)
+    s_big, m_big = big(state0, shard_batch((x, y), mesh8), rng)
+
+    accum = make_bsp_accum_step(_linreg_loss, tx, mesh8, donate=False)
+    stacked = (x.reshape(4, 16, 4), y.reshape(4, 16))
+    s_acc, m_acc = accum(state0, shard_batch(stacked, mesh8,
+                                             spec=P(None, AXIS_DATA)), rng)
+
+    for a, b in zip(jax.tree.leaves(s_big.params),
+                    jax.tree.leaves(s_acc.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # metrics: mean over microbatches == the big-batch mean loss
+    assert float(m_acc["loss"]) == pytest.approx(float(m_big["loss"]),
+                                                 rel=1e-6)
+    assert int(s_acc.step) == 1  # ONE optimizer update
+
+
+def test_accum_rejects_param_averaging(mesh8):
+    tx, _, _, _ = _setup(mesh8)
+    with pytest.raises(ValueError, match="exchange_what='grads'"):
+        make_bsp_accum_step(_linreg_loss, tx, mesh8,
+                            BSP_Exchanger(exchange_what="params"))
+
+
+def test_model_plumbing_counts_and_trains(mesh8, tmp_path):
+    from tests._tiny_models import TinyCifar
+
+    cfg = ModelConfig(batch_size=4, n_epochs=1, learning_rate=0.02,
+                      print_freq=0, grad_accum_steps=4,
+                      snapshot_dir=str(tmp_path))
+    m = TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+    m.compile_iter_fns("avg")
+    rec = Recorder(rank=0, size=8, print_freq=0)
+    n_iters = m.begin_epoch(0)
+    assert n_iters % 4 == 0 and n_iters > 0
+    steps_before = int(m.state.step)
+    it = 0
+    while it < n_iters:
+        consumed = m.train_iter(it, rec)
+        assert consumed == 4
+        it += consumed
+    m._flush_metrics(rec)
+    # one optimizer update per 4 consumed iterations
+    assert int(m.state.step) - steps_before == n_iters // 4
+    # recorder saw every image despite averaged metrics
+    assert rec.n_images == n_iters * m.global_batch
+    assert np.isfinite(rec.train_losses).all()
+    m.cleanup()
+
+
+def test_both_cadences_rejected(mesh8):
+    from tests._tiny_models import TinyCifar
+
+    cfg = ModelConfig(batch_size=4, print_freq=0, grad_accum_steps=2,
+                      steps_per_call=2)
+    m = TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+    with pytest.raises(ValueError, match="stacked-batch cadences"):
+        m.compile_iter_fns("avg")
+
+
+def test_async_rules_refuse_accum(tmp_path):
+    from theanompi_tpu import EASGD
+
+    cfg = ModelConfig(batch_size=4, n_epochs=1, print_freq=0,
+                      grad_accum_steps=2, snapshot_dir=str(tmp_path))
+    rule = EASGD()
+    rule.init(devices=2, modelfile="tests._tiny_models",
+              modelclass="TinyCifar", config=cfg, checkpoint=False)
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        rule.wait()
+
+
+def test_custom_step_models_reject_accum(mesh8):
+    """Models with their own step builders reject the knob at compile
+    time instead of crashing mid-epoch."""
+    from theanompi_tpu.models.transformer import TransformerLM_TP
+    from theanompi_tpu.parallel.mesh import MeshSpec, make_training_mesh
+
+    mesh = make_training_mesh(MeshSpec(data=2, model=4),
+                              jax.devices()[:8])
+    cfg = ModelConfig(batch_size=4, print_freq=0, grad_accum_steps=2,
+                      weight_decay=0.0)
+    m = TransformerLM_TP(config=cfg, mesh=mesh, verbose=False,
+                         n_layers=1, d_model=32, n_heads=4, seq_len=16)
+    with pytest.raises(ValueError, match="grad_accum_steps>1 is not"):
+        m.compile_iter_fns("avg")

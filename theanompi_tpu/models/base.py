@@ -135,6 +135,12 @@ class ModelConfig:
     #: (parallel/bsp.py make_bsp_multi_step) — amortizes per-dispatch
     #: tunnel overhead; 1 = one program per batch (reference cadence)
     steps_per_call: int = 1
+    #: accumulate gradients over this many microbatches before ONE
+    #: optimizer update (parallel/bsp.py make_bsp_accum_step): the
+    #: effective global batch is grad_accum_steps * batch_size * shards
+    #: at the HBM footprint of one microbatch.  Mutually exclusive with
+    #: steps_per_call > 1; BSP only
+    grad_accum_steps: int = 1
     seed: int = 42
     data_dir: str | None = None
     snapshot_dir: str = "./snapshots"
@@ -247,6 +253,7 @@ class TpuModel:
         self._rng = jax.random.key(self.config.seed + 1)
         self.train_step = None
         self.train_step_multi = None
+        self.train_step_accum = None
         self.eval_step = None
         self._train_prefetcher: DevicePrefetcher | None = None
         self._train_iter: Iterator | None = None
@@ -381,15 +388,35 @@ class TpuModel:
                                               self.mesh, exchanger,
                                               batch_partition=part,
                                               reduce_axes=axes)
+        if (self.config.steps_per_call > 1
+                and self.config.grad_accum_steps > 1):
+            raise ValueError(
+                "steps_per_call and grad_accum_steps are both stacked-"
+                "batch cadences; combine them by nesting is not "
+                "supported — set one of them to 1")
         if self.config.steps_per_call > 1:
             from theanompi_tpu.parallel.bsp import make_bsp_multi_step
 
             self.train_step_multi = make_bsp_multi_step(
                 self.loss_fn, self.tx, self.mesh, exchanger,
                 batch_partition=part, reduce_axes=axes)
+        if self.config.grad_accum_steps > 1:
+            from theanompi_tpu.parallel.bsp import make_bsp_accum_step
+
+            self.train_step_accum = make_bsp_accum_step(
+                self.loss_fn, self.tx, self.mesh, exchanger,
+                batch_partition=part, reduce_axes=axes)
         self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
                                             batch_partition=part,
                                             reduce_axes=axes)
+
+    def _reject_grad_accum(self, model_kind: str) -> None:
+        """Compile-time guard for models whose custom step builders
+        do not implement accumulation (call from compile_iter_fns
+        overrides, mirroring their steps_per_call guards)."""
+        if self.config.grad_accum_steps > 1:
+            raise ValueError(f"grad_accum_steps>1 is not implemented "
+                             f"for the {model_kind}")
 
     def compile_grad_fn(self):
         """Jitted gradient-only step for parameter-server rules (ASGD):
@@ -419,10 +446,13 @@ class TpuModel:
             n_iters = self.data.n_train_batches_for(
                 epoch, self.global_batch, self.shard_rank, self.shard_size)
         spec = self.batch_partition
-        k = self.config.steps_per_call
-        if k > 1:
-            host_iter = _stack_host_batches(host_iter, k)
-            n_iters -= n_iters % k
+        # both cadences stage a stacked batch; compile_iter_fns rejects
+        # setting both, so at most one of k/a exceeds 1
+        stack = max(self.config.steps_per_call,
+                    self.config.grad_accum_steps)
+        if stack > 1:
+            host_iter = _stack_host_batches(host_iter, stack)
+            n_iters -= n_iters % stack
             spec = self.stacked_batch_spec()
         self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh,
                                                   spec=spec)
@@ -430,10 +460,11 @@ class TpuModel:
         return n_iters
 
     def stacked_batch_spec(self):
-        """PartitionSpec of a k-stacked batch for ``train_step_multi``:
-        leading steps axis unsharded, per-step axes per
-        ``batch_partition`` — the single source bench.py and
-        ``begin_epoch`` both stage with."""
+        """PartitionSpec of a stacked batch (leading steps/microbatch
+        axis unsharded, per-step axes per ``batch_partition``) — the
+        single source bench.py and ``begin_epoch`` stage with, for BOTH
+        stacked cadences (``train_step_multi`` and
+        ``train_step_accum``)."""
         from jax.sharding import PartitionSpec as P
 
         from theanompi_tpu.parallel.mesh import AXIS_DATA
@@ -448,11 +479,13 @@ class TpuModel:
 
     def train_iter(self, count: int, recorder: Recorder) -> int:
         """One training dispatch; returns the number of iterations it
-        covered (``steps_per_call`` when the scanned multi-step is on,
-        else 1) so epoch drivers can advance their counters."""
+        covered (``steps_per_call`` for the scanned multi-step,
+        ``grad_accum_steps`` for accumulation, else 1) so epoch drivers
+        can advance their counters."""
         if self.train_step is None:
             raise RuntimeError("call compile_iter_fns() first")
         k = self.config.steps_per_call
+        a = self.config.grad_accum_steps
         recorder.start()
         batch = next(self._train_iter)
         recorder.end("wait")  # time blocked on the loader = reference 'wait'
@@ -463,6 +496,14 @@ class TpuModel:
             if k > 1:
                 self.state, metrics = self.train_step_multi(
                     self.state, batch, self._next_rng())
+            elif a > 1:
+                if self.train_step_accum is None:
+                    raise ValueError(
+                        f"{type(self).__name__}'s compile_iter_fns does "
+                        "not build an accumulation step; grad_accum_steps"
+                        ">1 is unsupported for this model")
+                self.state, metrics = self.train_step_accum(
+                    self.state, batch, self._next_rng())
             else:
                 self.state, metrics = self.train_step(self.state, batch,
                                                       self._next_rng())
@@ -471,10 +512,11 @@ class TpuModel:
         # flush window: print_freq when printing, else a fixed window so
         # quiet runs (print_freq<=0) still batch device syncs
         window = recorder.print_freq if recorder.print_freq > 0 else 50
-        if len(self._pending) * k >= window:
+        consumed = max(k, a)
+        if len(self._pending) * consumed >= window:
             self._flush_metrics(recorder)
             recorder.print_train_info(count)
-        return k
+        return consumed
 
     def _flush_metrics(self, recorder: Recorder) -> None:
         """Convert pending device metrics (blocks until the device has
@@ -483,12 +525,16 @@ class TpuModel:
         if not self._pending:
             return
         recorder.start()
+        # a scalar entry covers grad_accum_steps microbatches' images
+        # (metrics came back averaged over them); stacked entries carry
+        # one sub-step per leaf row
+        per_scalar = self.global_batch * self.config.grad_accum_steps
         for _, m in self._pending:
             loss = np.asarray(m["loss"])
             err = np.asarray(m["error"])
             if loss.ndim == 0:
                 recorder.train_metrics(float(loss), float(err),
-                                       self.global_batch)
+                                       per_scalar)
             else:
                 for l, e in zip(loss, err):
                     recorder.train_metrics(float(l), float(e),

@@ -78,6 +78,31 @@ def apply_update(tx: optax.GradientTransformation, state: "TrainState",
                       opt_state=new_opt, model_state=new_ms)
 
 
+def _default_exchanger(exchanger: BSP_Exchanger | None,
+                       reduce_axes: tuple[str, ...]) -> BSP_Exchanger:
+    return exchanger or BSP_Exchanger(
+        axis=reduce_axes if len(reduce_axes) > 1 else reduce_axes[0])
+
+
+def _fold_axis_rng(rng, reduce_axes: tuple[str, ...]):
+    """Decorrelate per-shard randomness (dropout, augment draws)."""
+    for ax in reduce_axes:
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+    return rng
+
+
+def _exchange_grads_and_update(exchanger: BSP_Exchanger,
+                               tx: optax.GradientTransformation,
+                               state: "TrainState", grads, new_ms,
+                               reduce_axes) -> "TrainState":
+    """Shared grads-mode tail: BN-stat pmean + exchange + update.
+    Used by the single/multi-step grads branch AND the accum step so
+    exchange semantics live in one place."""
+    new_ms = _pmean(new_ms, reduce_axes)
+    grads = exchanger.exchange(grads)
+    return apply_update(tx, state, grads, new_ms), new_ms
+
+
 def _make_shard_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
@@ -87,23 +112,20 @@ def _make_shard_step(
     """The per-shard training step body (one iteration): fwd + bwd +
     exchange + update + cross-replica syncs.  Shared by the single-step
     and the scanned multi-step builders."""
-    exchanger = exchanger or BSP_Exchanger(
-        axis=reduce_axes if len(reduce_axes) > 1 else reduce_axes[0])
+    exchanger = _default_exchanger(exchanger, reduce_axes)
 
     def shard_step(state: TrainState, batch, rng):
-        for ax in reduce_axes:
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+        rng = _fold_axis_rng(rng, reduce_axes)
         grads, new_ms, metrics = grad_and_metrics(
             loss_fn, state.params, state.model_state, batch, rng)
 
-        # Cross-replica sync of mutable collections (BN batch_stats):
-        # each shard saw a different micro-batch; average the stats.
-        new_ms = _pmean(new_ms, reduce_axes)
-
         if exchanger.exchange_what == "grads":
-            grads = exchanger.exchange(grads)
-            new_state = apply_update(tx, state, grads, new_ms)
+            new_state, _ = _exchange_grads_and_update(
+                exchanger, tx, state, grads, new_ms, reduce_axes)
         else:  # 'params': local update, then allreduce parameters
+            # Cross-replica sync of mutable collections (BN stats):
+            # each shard saw a different micro-batch; average them.
+            new_ms = _pmean(new_ms, reduce_axes)
             new_state = apply_update(tx, state, grads, new_ms)
             avg_exch = (
                 exchanger if exchanger.avg
@@ -195,6 +217,71 @@ def make_bsp_multi_step(
     stacked_partition = P(None, *batch_partition)
     sharded = jax.shard_map(
         shard_multi,
+        mesh=mesh,
+        in_specs=(P(), stacked_partition, P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_bsp_accum_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: jax.sharding.Mesh,
+    exchanger: BSP_Exchanger | None = None,
+    donate: bool = True,
+    batch_partition: P = P(AXIS_DATA),
+    reduce_axes: tuple[str, ...] = (AXIS_DATA,),
+):
+    """Gradient accumulation: ``a`` microbatches → ONE optimizer update.
+
+    Returns ``accum_step(state, stacked_batch, rng) -> (state, metrics)``
+    where ``stacked_batch`` arrays carry a leading microbatch axis ``a``
+    (per-microbatch batch axis behind it, sharded by
+    ``batch_partition``) and metrics come back averaged over the ``a``
+    microbatches.  Grads are averaged across microbatches locally, then
+    exchanged ONCE — so the effective global batch is
+    ``a * global_batch`` at the HBM footprint of one microbatch, and
+    the per-update ICI traffic of plain BSP.  Mean-of-means equals the
+    big-batch gradient exactly for equal microbatch sizes (tested).
+
+    Mutable model collections (BN batch_stats) thread through the scan
+    per-microbatch, matching what a sequential big-batch pass would do
+    step-wise.  ``exchange_what='params'`` has no well-defined
+    accumulation semantics and is rejected.
+    """
+    exchanger = _default_exchanger(exchanger, reduce_axes)
+    if exchanger.exchange_what != "grads":
+        raise ValueError("gradient accumulation requires "
+                         "exchange_what='grads' (param-averaging per "
+                         "microbatch has no accumulation semantics)")
+
+    def shard_accum(state: TrainState, stacked, rng):
+        rng = _fold_axis_rng(rng, reduce_axes)
+        a = jax.tree.leaves(stacked)[0].shape[0]
+
+        def body(carry, xs):
+            ms, gsum = carry
+            i, mb = xs
+            grads, ms, metrics = grad_and_metrics(
+                loss_fn, state.params, ms, mb, jax.random.fold_in(rng, i))
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            return (ms, gsum), metrics
+
+        gz = jax.tree.map(jnp.zeros_like, state.params)
+        (new_ms, gsum), metrics = jax.lax.scan(
+            body, (state.model_state, gz), (jnp.arange(a), stacked))
+        grads = jax.tree.map(lambda g: g / a, gsum)
+        metrics = jax.tree.map(lambda m: m.mean(axis=0), metrics)
+
+        new_state, _ = _exchange_grads_and_update(
+            exchanger, tx, state, grads, new_ms, reduce_axes)
+        return new_state, _pmean(metrics, reduce_axes)
+
+    stacked_partition = P(None, *batch_partition)
+    sharded = jax.shard_map(
+        shard_accum,
         mesh=mesh,
         in_specs=(P(), stacked_partition, P()),
         out_specs=(P(), P()),

@@ -80,6 +80,10 @@ class _AsyncRule(Rule):
                 "steps_per_call>1 (the scanned multi-step program) is a "
                 "BSP feature; the async rules exchange/gossip BETWEEN "
                 "iterations, which a fused k-step program would skip")
+        if getattr(cfg, "grad_accum_steps", 1) > 1:
+            raise ValueError(
+                "grad_accum_steps>1 is a BSP feature; the async rules' "
+                "exchange cadence is per-iteration")
         models = []
         for i, dev in enumerate(devs):
             m = cls(config=config, mesh=data_mesh(1, [dev]),

@@ -66,7 +66,8 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
         for epoch in range(start_epoch, n_epochs):
             n_iters = model.begin_epoch(epoch)
             it = 0
-            k = getattr(model.config, "steps_per_call", 1)
+            k = max(getattr(model.config, "steps_per_call", 1),
+                    getattr(model.config, "grad_accum_steps", 1))
             while it < n_iters:
                 # covers steps_per_call iterations per dispatch
                 consumed = model.train_iter(it, recorder)
