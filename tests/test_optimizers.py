@@ -102,3 +102,22 @@ class TestSchedules:
                                               m.state.params))
         del m_params
         m.cleanup()
+
+
+def test_label_smoothing_math():
+    """eps-smoothed CE == (1-eps)*CE + eps*uniform-CE, exactly."""
+    import jax
+
+    from theanompi_tpu.models.layers import softmax_cross_entropy
+
+    logits = jax.random.normal(jax.random.key(0), (8, 10))
+    labels = jnp.arange(8) % 10
+    eps = 0.1
+    plain = softmax_cross_entropy(logits, labels)
+    smooth = softmax_cross_entropy(logits, labels, eps)
+    logp = jax.nn.log_softmax(logits)
+    uniform_ce = -float(jnp.mean(logp))
+    assert float(smooth) == pytest.approx(
+        (1 - eps) * float(plain) + eps * uniform_ce, rel=1e-6)
+    # smoothing=0 is exactly the plain loss (no perf/precision cost)
+    assert float(softmax_cross_entropy(logits, labels, 0.0)) == float(plain)

@@ -113,6 +113,9 @@ class ModelConfig:
     #: linear warmup over the first N epochs (0 = off), applied before
     #: the schedule proper — the standard large-batch ramp
     warmup_epochs: int = 0
+    #: label smoothing eps for the classification CE (train loss only;
+    #: eval reports plain CE).  0.1 in modern 90-epoch ResNet recipes
+    label_smoothing: float = 0.0
     lr_scale_with_workers: str | None = None   # None | 'linear' | 'sqrt'
     exchange_strategy: str = "psum"        # reference names accepted (nccl16...)
     exchange_what: str = "grads"
@@ -321,14 +324,16 @@ class TpuModel:
             logits = self.module.apply(variables, x, train=True,
                                        rngs={"dropout": rng})
             new_ms = model_state
+        smooth = self.config.label_smoothing  # train-time only; eval
         if isinstance(logits, (tuple, list)):  # aux heads (GoogLeNet)
-            main, *aux = logits
-            loss = softmax_cross_entropy(main, y)
+            main, *aux = logits                 # reports plain CE
+            loss = softmax_cross_entropy(main, y, smooth)
             for a_logits, a_w in aux:
-                loss = loss + a_w * softmax_cross_entropy(a_logits, y)
+                loss = loss + a_w * softmax_cross_entropy(a_logits, y,
+                                                          smooth)
             logits = main
         else:
-            loss = softmax_cross_entropy(logits, y)
+            loss = softmax_cross_entropy(logits, y, smooth)
         metrics = {"loss": loss, "error": error_rate(logits, y)}
         if self.config.track_top5:
             metrics["top5_error"] = topk_error(logits, y, 5)

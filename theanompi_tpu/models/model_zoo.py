@@ -62,6 +62,7 @@ class ResNet50_LargeBatch(ResNet50):
             weight_decay=1e-4,
             lr_schedule="cosine",
             warmup_epochs=5,
+            label_smoothing=0.1,
             compute_dtype="bfloat16",
             resnet_stem="s2d",
             track_top5=True,

@@ -192,7 +192,8 @@ class TransformerLM(TpuModel):
                                    rngs={"dropout": rng})
         v = logits.shape[-1]
         loss = L.softmax_cross_entropy(logits.reshape(-1, v),
-                                       targets.reshape(-1))
+                                       targets.reshape(-1),
+                                       self.config.label_smoothing)
         err = L.error_rate(logits.reshape(-1, v), targets.reshape(-1))
         return loss, (model_state, {"loss": loss, "error": err})
 
@@ -412,8 +413,9 @@ class TransformerLM_PP(TpuModel):
         # backward on the last stage only; the step psums metrics and
         # the single-stage params' grads over 'pipe'
         mask = last_stage_mask()
-        loss = mask * L.softmax_cross_entropy(logits.reshape(-1, v),
-                                              targets.reshape(-1))
+        loss = mask * L.softmax_cross_entropy(
+            logits.reshape(-1, v), targets.reshape(-1),
+            self.config.label_smoothing)
         err = mask * L.error_rate(logits.reshape(-1, v),
                                   targets.reshape(-1))
         return loss, (model_state, {"loss": loss, "error": err})
@@ -646,7 +648,8 @@ class TransformerLM_MoE(TpuModel):
         logits, aux = self._forward(params, tokens)
         v = logits.shape[-1]
         ce = L.softmax_cross_entropy(logits.reshape(-1, v),
-                                     targets.reshape(-1))
+                                     targets.reshape(-1),
+                                     self.config.label_smoothing)
         err = L.error_rate(logits.reshape(-1, v), targets.reshape(-1))
         loss = ce + self.aux_weight * aux / self._net_cfg["n_layers"]
         return loss, (model_state, {"loss": ce, "error": err,

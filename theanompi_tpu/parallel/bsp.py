@@ -100,7 +100,7 @@ def _exchange_grads_and_update(exchanger: BSP_Exchanger,
     exchange semantics live in one place."""
     new_ms = _pmean(new_ms, reduce_axes)
     grads = exchanger.exchange(grads)
-    return apply_update(tx, state, grads, new_ms), new_ms
+    return apply_update(tx, state, grads, new_ms)
 
 
 def _make_shard_step(
@@ -120,7 +120,7 @@ def _make_shard_step(
             loss_fn, state.params, state.model_state, batch, rng)
 
         if exchanger.exchange_what == "grads":
-            new_state, _ = _exchange_grads_and_update(
+            new_state = _exchange_grads_and_update(
                 exchanger, tx, state, grads, new_ms, reduce_axes)
         else:  # 'params': local update, then allreduce parameters
             # Cross-replica sync of mutable collections (BN stats):
@@ -275,7 +275,7 @@ def make_bsp_accum_step(
         grads = jax.tree.map(lambda g: g / a, gsum)
         metrics = jax.tree.map(lambda m: m.mean(axis=0), metrics)
 
-        new_state, _ = _exchange_grads_and_update(
+        new_state = _exchange_grads_and_update(
             exchanger, tx, state, grads, new_ms, reduce_axes)
         return new_state, _pmean(metrics, reduce_axes)
 

@@ -77,7 +77,8 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
                     if k > 1:
                         raise RuntimeError(
                             f"{type(model).__name__}.train_iter returned "
-                            "None with steps_per_call>1; it must return "
+                            "None with a stacked cadence (steps_per_call"
+                            " or grad_accum_steps > 1); it must return "
                             "the number of iterations consumed")
                     consumed = 1
                 it += consumed
