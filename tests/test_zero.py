@@ -1,0 +1,145 @@
+"""ZeRO-1 optimizer-state sharding (parallel/zero.py +
+ModelConfig.zero_sharding): reduce_scatter/update-shard/all_gather,
+step-equal to plain BSP, state physically sharded."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.parallel.bsp import TrainState, make_bsp_train_step
+from theanompi_tpu.parallel.mesh import AXIS_DATA, data_mesh, shard_batch
+from theanompi_tpu.parallel.zero import (
+    init_zero_opt_state,
+    make_bsp_zero_step,
+)
+from theanompi_tpu.utils.helper_funcs import (
+    build_optimizer,
+    get_learning_rate,
+    set_learning_rate,
+)
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def _loss(params, model_state, batch, rng):
+    x, y = batch
+    pred = jnp.tanh(x @ params["w1"]) @ params["w2"] + params["b"]
+    loss = jnp.mean((pred - y) ** 2)
+    return loss, (model_state, {"loss": loss, "error": loss})
+
+
+def _params():
+    k = jax.random.key(0)
+    k1, k2 = jax.random.split(k)
+    # deliberately not divisible by 8 so the pad path is exercised
+    return {"w1": jax.random.normal(k1, (5, 7)),
+            "w2": jax.random.normal(k2, (7, 3)),
+            "b": jnp.zeros((3,))}
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adamw"])
+def test_zero_step_equals_plain_bsp(mesh8, opt):
+    """N steps of ZeRO == N steps of plain BSP (elementwise update is
+    sharding-transparent), while opt state lives 1/8 per device."""
+    tx = build_optimizer(0.05, optimizer=opt, momentum=0.9,
+                         weight_decay=1e-4)
+    params = _params()
+    rng_np = np.random.default_rng(1)
+    x = rng_np.standard_normal((32, 5)).astype(np.float32)
+    y = rng_np.standard_normal((32, 3)).astype(np.float32)
+    rng = jax.random.key(2)
+
+    plain = make_bsp_train_step(_loss, tx, mesh8, donate=False)
+    s_p = TrainState.create(params, tx)
+
+    zero = make_bsp_zero_step(_loss, tx, mesh8, params, donate=False)
+    opt0, specs = init_zero_opt_state(tx, params, mesh8)
+    s_z = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                     opt_state=opt0, model_state={})
+
+    batch = shard_batch((x, y), mesh8)
+    for _ in range(3):
+        s_p, m_p = plain(s_p, batch, rng)
+        s_z, m_z = zero(s_z, batch, rng)
+    for a, b in zip(jax.tree.leaves(s_p.params),
+                    jax.tree.leaves(s_z.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+    assert float(m_z["loss"]) == pytest.approx(float(m_p["loss"]),
+                                               rel=1e-5)
+
+
+def test_opt_state_physically_sharded(mesh8):
+    tx = build_optimizer(0.1, optimizer="sgd", momentum=0.9)
+    params = _params()
+    opt0, specs = init_zero_opt_state(tx, params, mesh8)
+    vec_leaves = [l for l in jax.tree.leaves(opt0)
+                  if getattr(l, "ndim", 0) == 1 and l.size >= 8]
+    assert vec_leaves, "expected momentum vector slots"
+    for leaf in vec_leaves:
+        # each device holds 1/8 of the padded flat vector
+        shard_shapes = {s.data.shape for s in leaf.addressable_shards}
+        assert shard_shapes == {(leaf.shape[0] // 8,)}, leaf.sharding
+    # lr stays mutable through the sharded state (adjust_hyperp path)
+    opt1 = set_learning_rate(opt0, 0.01)
+    assert get_learning_rate(opt1) == pytest.approx(0.01)
+
+
+def test_model_trains_with_zero_and_lr_schedule(mesh8, tmp_path):
+    from tests._tiny_models import TinyCifar
+
+    cfg = ModelConfig(batch_size=4, n_epochs=1, learning_rate=0.02,
+                      print_freq=0, zero_sharding=True,
+                      lr_schedule="step", lr_decay_epochs=(1,),
+                      snapshot_dir=str(tmp_path))
+    m = TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+    m.compile_iter_fns("avg")
+    rec = Recorder(rank=0, size=8, print_freq=0)
+    m.begin_epoch(0)
+    for i in range(3):
+        m.train_iter(i, rec)
+    m._flush_metrics(rec)
+    assert np.isfinite(rec.train_losses).all()
+    assert m.adjust_hyperp(1) == pytest.approx(0.002)
+    # the schedule's new lr feeds back through the sharded state
+    m.train_iter(3, rec)
+    m._flush_metrics(rec)
+    assert np.isfinite(rec.train_losses).all()
+    m.cleanup()
+
+
+def test_zero_rejects_unsupported(mesh8):
+    from tests._tiny_models import TinyCifar
+
+    for bad, msg in [
+        (dict(optimizer="lars"), "ELEMENTWISE"),
+        (dict(steps_per_call=2), "stacked cadences"),
+        (dict(exchange_what="params"), "IS the gradient exchange"),
+    ]:
+        cfg = ModelConfig(batch_size=4, print_freq=0, zero_sharding=True,
+                          **bad)
+        with pytest.raises(ValueError, match=msg):
+            TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+
+
+def test_zero_rejects_bf16_strategy_and_variant_models(mesh8):
+    from tests._tiny_models import TinyCifar
+    from theanompi_tpu.models.transformer import TransformerLM_TP
+    from theanompi_tpu.parallel.mesh import MeshSpec, make_training_mesh
+
+    cfg = ModelConfig(batch_size=4, print_freq=0, zero_sharding=True,
+                      exchange_strategy="nccl16")
+    with pytest.raises(ValueError, match="full-precision"):
+        TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+
+    mesh = make_training_mesh(MeshSpec(data=2, model=4),
+                              jax.devices()[:8])
+    cfg = ModelConfig(batch_size=4, print_freq=0, zero_sharding=True,
+                      weight_decay=0.0)
+    m = TransformerLM_TP(config=cfg, mesh=mesh, verbose=False,
+                         n_layers=1, d_model=32, n_heads=4, seq_len=16)
+    with pytest.raises(ValueError, match="zero_sharding is not"):
+        m.compile_iter_fns("avg")

@@ -144,6 +144,11 @@ class ModelConfig:
     #: at the HBM footprint of one microbatch.  Mutually exclusive with
     #: steps_per_call > 1; BSP only
     grad_accum_steps: int = 1
+    #: ZeRO-1: shard the optimizer state over the data axis
+    #: (parallel/zero.py — reduce_scatter grads, update the 1/N shard,
+    #: all_gather params).  Step-equal to plain BSP for elementwise
+    #: optimizers; BSP over a pure data mesh only
+    zero_sharding: bool = False
     seed: int = 42
     data_dir: str | None = None
     snapshot_dir: str = "./snapshots"
@@ -185,9 +190,53 @@ class TpuModel:
         (optimizer init included) then replicate over the mesh — pure
         DP.  Parameter-sharded models (TP) override so the optimizer
         state is built directly from SHARDED params and never
-        materializes full-size on any device."""
+        materializes full-size on any device.  ZeRO-1
+        (``zero_sharding``) replicates params but builds the optimizer
+        state sharded over 'data'."""
+        if self.config.zero_sharding:
+            from theanompi_tpu.parallel.zero import init_zero_opt_state
+
+            self._check_zero_supported()
+            opt_state, _ = init_zero_opt_state(self.tx, params, self.mesh)
+            params_r, ms_r, step_r = replicate(
+                (params, model_state, jnp.zeros((), jnp.int32)), self.mesh)
+            return TrainState(step=step_r, params=params_r,
+                              opt_state=opt_state, model_state=ms_r)
         return replicate(TrainState.create(params, self.tx, model_state),
                          self.mesh)
+
+    def _check_zero_supported(self) -> None:
+        from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+        cfg = self.config
+        part, axes = self._batch_axes()
+        if axes != (AXIS_DATA,):
+            raise ValueError("zero_sharding composes with the pure data "
+                             f"mesh only (got reduce axes {axes})")
+        if cfg.optimizer == "lars":
+            raise ValueError("zero_sharding needs an ELEMENTWISE "
+                             "optimizer; lars computes layerwise trust "
+                             "ratios which a flat shard cannot see")
+        if cfg.steps_per_call > 1 or cfg.grad_accum_steps > 1:
+            raise ValueError("zero_sharding does not compose with the "
+                             "stacked cadences yet")
+        if cfg.exchange_what != "grads":
+            raise ValueError("zero_sharding IS the gradient exchange; "
+                             "exchange_what='params' does not apply")
+        from theanompi_tpu.parallel.exchanger import resolve_strategy
+
+        if resolve_strategy(cfg.exchange_strategy) != "psum":
+            raise ValueError(
+                f"zero_sharding's reduce_scatter runs full-precision; "
+                f"the bf16-compressed strategy "
+                f"{cfg.exchange_strategy!r} does not apply")
+
+    def _reject_zero_sharding(self, model_kind: str) -> None:
+        """Compile-time guard mirroring _reject_grad_accum for models
+        with their own state/step builders."""
+        if self.config.zero_sharding:
+            raise ValueError(f"zero_sharding is not implemented for "
+                             f"the {model_kind}")
 
     def adopt_restored_state(self, state: "TrainState") -> "TrainState":
         """Hook for checkpoint resume: re-establish this model's device
@@ -383,6 +432,18 @@ class TpuModel:
         """Build the jitted SPMD steps (the reference's Theano-function
         compile; ``sync_type`` 'avg' vs 'cdd' maps to exchange avg/sum)."""
         part, axes = self._batch_axes()
+        if self.config.zero_sharding:
+            from theanompi_tpu.parallel.zero import make_bsp_zero_step
+
+            self._check_zero_supported()
+            self.train_step = make_bsp_zero_step(
+                self.loss_fn, self.tx, self.mesh,
+                params_template=self.state.params,  # shapes only
+                avg=(sync_type != "cdd"), batch_partition=part)
+            self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
+                                                batch_partition=part,
+                                                reduce_axes=axes)
+            return
         exchanger = BSP_Exchanger(
             strategy=self.config.exchange_strategy,
             avg=(sync_type != "cdd"),

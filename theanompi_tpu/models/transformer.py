@@ -264,6 +264,9 @@ class TransformerLM_TP(TransformerLM):
         )
 
         self._reject_grad_accum("GSPMD tensor-parallel step")
+        self._reject_zero_sharding("GSPMD tensor-parallel step (its "
+                                   "optimizer state is already sharded "
+                                   "like the params)")
         scale = float(data_axis_size(self.mesh)) if sync_type == "cdd" \
             else 1.0
         self.train_step = make_gspmd_train_step(self.loss_fn, self.tx,
@@ -442,6 +445,7 @@ class TransformerLM_PP(TpuModel):
         from theanompi_tpu.parallel.tensor import opt_state_specs
 
         self._reject_grad_accum("pipeline/expert step")
+        self._reject_zero_sharding("pipeline/expert step")
         if self.config.steps_per_call > 1:
             raise ValueError("steps_per_call>1 is not implemented for the "
                              "pipeline-parallel path")
@@ -673,6 +677,7 @@ class TransformerLM_MoE(TpuModel):
         from theanompi_tpu.parallel.tensor import opt_state_specs
 
         self._reject_grad_accum("pipeline/expert step")
+        self._reject_zero_sharding("pipeline/expert step")
         if self.config.steps_per_call > 1:
             raise ValueError("steps_per_call>1 is not implemented for the "
                              "expert-parallel path")

@@ -175,6 +175,7 @@ class Wasserstein_GAN(TpuModel):
 
     def compile_iter_fns(self, sync_type: str = "avg") -> None:
         self._reject_grad_accum("WGAN round step")
+        self._reject_zero_sharding("WGAN round step")
         gen, critic = self.generator, self.critic
         gen_tx, critic_tx = self.gen_tx, self.critic_tx
         n_critic, clip_c, latent = self.n_critic, self.clip_c, self.latent_dim

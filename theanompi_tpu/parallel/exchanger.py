@@ -54,6 +54,17 @@ _STRATEGY_ALIASES = {
 }
 
 
+def resolve_strategy(name: str) -> str:
+    """Map a reference-era strategy name to its TPU numeric strategy
+    ('psum' | 'psum_bf16'); raises on unknown names."""
+    try:
+        return _STRATEGY_ALIASES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown exchange strategy {name!r}; "
+            f"expected one of {sorted(_STRATEGY_ALIASES)}") from None
+
+
 @dataclasses.dataclass(frozen=True)
 class BSP_Exchanger:
     """BSP exchange semantics, applied *inside* the SPMD training step.

@@ -1,0 +1,146 @@
+"""ZeRO-1 data parallelism: optimizer state sharded over ``data``.
+
+Plain BSP replicates the optimizer state (momentum, adam moments) on
+every data shard — for a model with P parameters and an optimizer with
+m state slots, each chip holds m*P floats it only ever reads 1/N of
+usefully.  ZeRO-1 shards that state over the data axis:
+
+    grads  --psum_scatter-->  1/N grad shard        (reduce_scatter)
+    update on the 1/N param/opt shard               (compute saved too)
+    params --all_gather-->    full replicated tree
+
+Same collective volume as one psum (reduce_scatter + all_gather IS the
+ring allreduce, just with the update between the halves), identical
+update math for elementwise optimizers (sgd/momentum/adam/adamw/
+rmsprop — proven step-equal to plain BSP in tests), and m*P/N
+optimizer memory per chip.  LARS is layerwise, not elementwise, so it
+is rejected (a flat shard has no layer boundaries).
+
+The reference has no analogue (its exchanger zoo allreduced grads or
+params, SURVEY.md §2.4); this is the TPU-era completion of that zoo —
+selected as ``ModelConfig.zero_sharding=True``, BSP only.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.bsp import (
+    TrainState,
+    _fold_axis_rng,
+    _pmean,
+    grad_and_metrics,
+)
+from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+PyTree = Any
+
+
+def _flat_info(params: PyTree, n_shards: int) -> tuple[int, int, int]:
+    """(total, pad, per_shard) for the flattened param vector."""
+    total = sum(int(np.prod(l.shape)) if hasattr(l, "shape") else 1
+                for l in jax.tree.leaves(params))
+    pad = (-total) % n_shards
+    return total, pad, (total + pad) // n_shards
+
+
+def _opt_specs(tx: optax.GradientTransformation, per_shard: int):
+    """Per-leaf PartitionSpecs for the sharded optimizer state: vector
+    slots (momentum/moments, shape (per_shard,)) live on 'data';
+    scalars (inject_hyperparams' learning_rate, counts) replicate."""
+    template = jax.eval_shape(tx.init, jnp.zeros((per_shard,), jnp.float32))
+    specs = jax.tree.map(
+        lambda l: P(AXIS_DATA) if (getattr(l, "ndim", 0) == 1
+                                   and l.shape[0] == per_shard) else P(),
+        template)
+    return template, specs
+
+
+def init_zero_opt_state(tx: optax.GradientTransformation, params: PyTree,
+                        mesh: jax.sharding.Mesh):
+    """Build the optimizer state directly SHARDED over 'data' (never
+    materializing the full-size state on any device)."""
+    n = mesh.shape[AXIS_DATA]
+    total, pad, per_shard = _flat_info(params, n)
+    _, specs = _opt_specs(tx, per_shard)
+
+    def shard_init(params):
+        idx = lax.axis_index(AXIS_DATA)
+        pflat, _ = ravel_pytree(params)
+        pflat = jnp.pad(pflat.astype(jnp.float32), (0, pad))
+        pshard = lax.dynamic_slice(pflat, (idx * per_shard,), (per_shard,))
+        return tx.init(pshard)
+
+    sharded = jax.shard_map(shard_init, mesh=mesh, in_specs=(P(),),
+                            out_specs=specs, check_vma=False)
+    return jax.jit(sharded)(params), specs
+
+
+def make_bsp_zero_step(
+    loss_fn,
+    tx: optax.GradientTransformation,
+    mesh: jax.sharding.Mesh,
+    params_template: PyTree,
+    avg: bool = True,
+    donate: bool = True,
+    batch_partition: P = P(AXIS_DATA),
+):
+    """Build the ZeRO-1 training step.
+
+    ``step(state, batch, rng) -> (state, metrics)`` with ``state.params``
+    replicated and ``state.opt_state`` sharded over 'data' (the specs
+    come from ``init_zero_opt_state``).  Reduction is over the data
+    axis only (compose-with-seq is future work — the model layer
+    rejects other reduce axes).
+    """
+    n = mesh.shape[AXIS_DATA]
+    total, pad, per_shard = _flat_info(params_template, n)
+    _, opt_specs = _opt_specs(tx, per_shard)
+    state_in_specs = TrainState(step=P(), params=P(), opt_state=opt_specs,
+                                model_state=P())
+
+    def shard_step(state: TrainState, batch, rng):
+        rng = _fold_axis_rng(rng, (AXIS_DATA,))
+        grads, new_ms, metrics = grad_and_metrics(
+            loss_fn, state.params, state.model_state, batch, rng)
+        new_ms = _pmean(new_ms, (AXIS_DATA,))
+
+        gflat, _ = ravel_pytree(grads)
+        gflat = jnp.pad(gflat.astype(jnp.float32), (0, pad))
+        # reduce_scatter: each shard ends with the SUM of its slice
+        gshard = lax.psum_scatter(gflat, AXIS_DATA, scatter_dimension=0,
+                                  tiled=True)
+        if avg:
+            gshard = gshard / n
+
+        idx = lax.axis_index(AXIS_DATA)
+        pflat, unravel = ravel_pytree(state.params)
+        pdtype = pflat.dtype
+        pflat = jnp.pad(pflat.astype(jnp.float32), (0, pad))
+        pshard = lax.dynamic_slice(pflat, (idx * per_shard,), (per_shard,))
+
+        updates, new_opt = tx.update(gshard, state.opt_state, pshard)
+        new_pshard = optax.apply_updates(pshard, updates)
+        new_pflat = lax.all_gather(new_pshard, AXIS_DATA, tiled=True)
+        new_params = unravel(new_pflat[:total].astype(pdtype))
+
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt, model_state=new_ms)
+        return new_state, _pmean(metrics, (AXIS_DATA,))
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(state_in_specs, batch_partition, P()),
+        out_specs=(state_in_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
