@@ -27,3 +27,12 @@ class StragglerTinyCifar(TinyCifar):
 
             time.sleep(self.straggler_sleep_s)
         return super().train_iter(count, recorder)
+
+
+class TinyCifar128(TinyCifar):
+    """128-sample variant: a full epoch at global batch 32 is 4
+    dispatches — for cadence-accounting tests that must walk a whole
+    epoch."""
+
+    def build_data(self):
+        return Cifar10_data(synthetic_n=128, seed=self.config.seed)

@@ -53,8 +53,10 @@ def test_bsp_rule_api(mesh8, tmp_path):
 
     cfg = small_cfg(tmp_path, n_epochs=1)
     rule = BSP()
-    rule.init(devices=8, modelfile="theanompi_tpu.models.cifar10",
-              modelclass="Cifar10_model", config=cfg, checkpoint=False)
+    # a tiny dataset keeps the epoch short; the zoo-shortname path is
+    # covered by test_launcher.test_tmlocal_bsp_end_to_end
+    rule.init(devices=8, modelfile="tests._tiny_models",
+              modelclass="TinyCifar128", config=cfg, checkpoint=False)
     res = rule.wait()
     assert res["epochs_run"] == 1
     assert "error" in res["val"]

@@ -162,12 +162,22 @@ class TestEndToEnd:
         m.compile_iter_fns("avg")
         from theanompi_tpu.utils.recorder import Recorder
 
+        import jax
+
+        before = jax.tree.map(np.asarray, m.state.model_state)
         rec = Recorder(rank=0, size=8, print_freq=0)
         n = m.begin_epoch(0)
         for it in range(min(n, 3)):
             m.train_iter(it, rec)
         m._flush_metrics(rec)
         assert np.isfinite(rec.train_losses).all()
+        # BN running stats moved through the train_iter path (the
+        # fast-set home of the contract test_bn_state_updates pins in
+        # the slow set)
+        after = jax.tree.map(np.asarray, m.state.model_state)
+        assert any(not np.allclose(a, b)
+                   for a, b in zip(jax.tree.leaves(after),
+                                   jax.tree.leaves(before)))
         val = m.val_epoch(rec)
         assert np.isfinite(val["loss"])
         m.cleanup()

@@ -74,12 +74,12 @@ def test_accum_rejects_param_averaging(mesh8):
 
 
 def test_model_plumbing_counts_and_trains(mesh8, tmp_path):
-    from tests._tiny_models import TinyCifar
+    from tests._tiny_models import TinyCifar128
 
     cfg = ModelConfig(batch_size=4, n_epochs=1, learning_rate=0.02,
                       print_freq=0, grad_accum_steps=4,
                       snapshot_dir=str(tmp_path))
-    m = TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+    m = TinyCifar128(config=cfg, mesh=mesh8, verbose=False)
     m.compile_iter_fns("avg")
     rec = Recorder(rank=0, size=8, print_freq=0)
     n_iters = m.begin_epoch(0)

@@ -156,6 +156,8 @@ class TestResNet50:
         assert "top5_error" in v and 0.0 <= v["error"] <= 1.0
         m.cleanup()
 
+    @pytest.mark.slow  # fast-set coverage: the BN-movement assert in
+    # test_device_augment.py's e2e (same contract, one compile)
     def test_bn_state_updates(self, mesh8):
         from theanompi_tpu.utils.recorder import Recorder
         import jax

@@ -65,13 +65,13 @@ class TestModelPlumbing:
     def test_cifar_trains_with_steps_per_call(self, mesh8, tmp_path):
         """The contract path: begin_epoch stacks host batches, train_iter
         reports k consumed, the recorder sees every sub-step's metrics."""
-        from tests._tiny_models import TinyCifar
+        from tests._tiny_models import TinyCifar128
         from theanompi_tpu.utils.recorder import Recorder
 
         cfg = ModelConfig(batch_size=4, n_epochs=1, learning_rate=0.02,
                           print_freq=0, steps_per_call=4,
                           snapshot_dir=str(tmp_path))
-        m = TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+        m = TinyCifar128(config=cfg, mesh=mesh8, verbose=False)
         m.compile_iter_fns("avg")
         rec = Recorder(rank=0, size=8, print_freq=0)
         n_iters = m.begin_epoch(0)

@@ -89,13 +89,13 @@ def test_opt_state_physically_sharded(mesh8):
 
 
 def test_model_trains_with_zero_and_lr_schedule(mesh8, tmp_path):
-    from tests._tiny_models import TinyCifar
+    from tests._tiny_models import TinyCifar128
 
     cfg = ModelConfig(batch_size=4, n_epochs=1, learning_rate=0.02,
                       print_freq=0, zero_sharding=True,
                       lr_schedule="step", lr_decay_epochs=(1,),
                       snapshot_dir=str(tmp_path))
-    m = TinyCifar(config=cfg, mesh=mesh8, verbose=False)
+    m = TinyCifar128(config=cfg, mesh=mesh8, verbose=False)
     m.compile_iter_fns("avg")
     rec = Recorder(rank=0, size=8, print_freq=0)
     m.begin_epoch(0)
