@@ -1,0 +1,58 @@
+"""utils/checkpoint.py unit semantics (the rule-level resume paths are
+covered in test_async_rules/test_bsp_training/test_multihost)."""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.utils.checkpoint import Checkpointer
+
+
+def test_async_save_snapshots_before_background_write(tmp_path):
+    """save() returns while Orbax writes in the background; the
+    payload must be snapshotted so caller mutations after return never
+    reach the file."""
+    ck = Checkpointer(str(tmp_path), max_to_keep=3)
+    buf = np.arange(8.0)
+    ck.save(0, {"w": buf, "epoch": 0})
+    buf += 100.0  # mutate after the (async) save returned
+    ck.save(1, {"w": buf, "epoch": 1})
+    assert np.allclose(ck.restore(0)["w"], np.arange(8.0))
+    assert np.allclose(ck.restore(1)["w"], np.arange(8.0) + 100.0)
+    ck.close()
+
+    # reopen: writes were durable and complete
+    ck2 = Checkpointer(str(tmp_path))
+    assert ck2.latest_epoch() == 1
+    assert ck2.kept_epochs() == {0, 1}
+    ck2.close()
+
+
+def test_sync_mode_still_available(tmp_path):
+    ck = Checkpointer(str(tmp_path), async_save=False)
+    ck.save(0, {"x": np.ones(3)})
+    assert ck.latest_epoch() == 0
+    ck.close()
+
+
+def test_restore_missing_raises(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        ck.restore()
+    ck.close()
+
+
+def test_close_does_not_mask_propagating_exception(tmp_path, capsys):
+    """A checkpoint-teardown failure inside a finally block must not
+    replace the real error."""
+    ck = Checkpointer(str(tmp_path))
+    ck.save(0, {"x": np.ones(2)})
+    ck._mgr.close()  # sabotage: the wrapper's close will now fail
+
+    class Boom(Exception):
+        pass
+
+    with pytest.raises(Boom):  # Boom survives; close's error is printed
+        try:
+            raise Boom("the real failure")
+        finally:
+            ck.close()

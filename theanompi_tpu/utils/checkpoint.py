@@ -25,10 +25,20 @@ PyTree = Any
 
 
 class Checkpointer:
-    """Thin synchronous Orbax wrapper with epoch-numbered directories."""
+    """Thin Orbax wrapper with epoch-numbered directories.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    Saves are ASYNC by default: ``save`` snapshots the state to host
+    (the device copy — unavoidable) and returns while Orbax writes the
+    files in the background, so the next epoch trains during the I/O;
+    the previous write is fenced at the start of the next ``save``, in
+    ``restore``/``latest_epoch``/``kept_epochs``, and in ``close``.
+    Pass ``async_save=False`` for the reference's fully-synchronous
+    per-epoch semantics."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
         self.directory = os.path.abspath(directory)
+        self.async_save = async_save
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -37,21 +47,43 @@ class Checkpointer:
             ),
         )
 
+    def _fence(self) -> None:
+        """Join any in-flight background write, surfacing its error
+        with checkpoint context (an async write failure otherwise
+        reads like an unrelated crash at the next epoch)."""
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as e:
+            raise RuntimeError(
+                f"background checkpoint write to {self.directory} "
+                f"failed: {e}") from e
+
     def save(self, epoch: int, payload: PyTree, force: bool = False) -> None:
-        # Move to host numpy so the checkpoint is device-layout agnostic.
-        payload = jax.tree.map(np.asarray, payload)
+        # Move to host numpy so the checkpoint is device-layout
+        # agnostic (sharded ZeRO/TP states materialize their global
+        # arrays here) — this snapshot is what makes the async write
+        # safe against further training mutating the state.
+        self._fence()  # fence any in-flight write
+        # np.array (not asarray): device arrays copy either way, but a
+        # host-numpy payload must ALSO be copied or the async write
+        # races with caller mutations
+        payload = jax.tree.map(lambda l: np.array(l), payload)
         self._mgr.save(epoch, args=ocp.args.StandardSave(payload), force=force)
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
 
     def latest_epoch(self) -> int | None:
+        self._fence()
         return self._mgr.latest_step()
 
     def kept_epochs(self) -> set[int]:
         """Epochs still on disk after max_to_keep pruning — callers
         with sidecar files (GOSGD per-worker params) prune to match."""
+        self._fence()
         return set(self._mgr.all_steps())
 
     def restore(self, epoch: int | None = None, like: PyTree | None = None) -> PyTree:
+        self._fence()
         if epoch is None:
             epoch = self.latest_epoch()
         if epoch is None:
@@ -62,4 +94,19 @@ class Checkpointer:
         return self._mgr.restore(epoch)
 
     def close(self) -> None:
-        self._mgr.close()
+        # Close runs in the rules' finally blocks: if an exception is
+        # already propagating there, a background-write failure here
+        # must not MASK it — report and let the original through.
+        import sys
+
+        propagating = sys.exc_info()[1] is not None
+        try:
+            self._fence()
+            self._mgr.close()
+        except Exception as e:
+            if propagating:
+                print(f"[checkpoint] close failed while another error "
+                      f"propagates (reporting, not masking): {e}",
+                      file=sys.stderr)
+                return
+            raise
