@@ -18,7 +18,9 @@ is rejected (a flat shard has no layer boundaries).
 
 The reference has no analogue (its exchanger zoo allreduced grads or
 params, SURVEY.md §2.4); this is the TPU-era completion of that zoo —
-selected as ``ModelConfig.zero_sharding=True``, BSP only.
+selected as ``ModelConfig.zero_sharding=True``, BSP only.  The
+pattern is the cross-replica weight-update sharding of
+arXiv:2004.13336 (retrieved in PAPERS.md) / ZeRO stage 1.
 """
 
 from __future__ import annotations
