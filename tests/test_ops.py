@@ -193,3 +193,85 @@ class TestFusedAttention:
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             rtol=2e-2, atol=2e-2)
+
+    def test_fused_bwd_kernel_matches_xla_bwd(self):
+        """The flash-style Pallas bwd (recompute-from-lse, fp32
+        accumulation) == the composed-XLA VJP, incl. global positions."""
+        import jax.numpy as jnp
+
+        import theanompi_tpu.ops.attention as A
+
+        q, k, v = self._rand(tq=16, tk=48)
+        q_pos = 32 + jnp.arange(16)
+        k_pos = jnp.arange(48)
+        g = jax.random.normal(jax.random.key(9), q.shape)
+        scale = q.shape[-1] ** -0.5
+        _, lse = A._pallas_attention(q, k, v, q_pos, k_pos, scale,
+                                     True, interpret=True)
+        got = A._pallas_attention_bwd(q, k, v, q_pos, k_pos, lse, g,
+                                      scale, True, interpret=True)
+        want = A._xla_bwd(q, k, v, q_pos, k_pos, scale, True, g)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)
+
+    def test_fused_bwd_ragged_falls_back(self, monkeypatch):
+        """tq not divisible by the q-block -> the VJP routes to the
+        XLA bwd and grads still match the oracle."""
+        import theanompi_tpu.ops.attention as A
+        from theanompi_tpu.parallel.sequence import attention_reference
+
+        monkeypatch.setattr(A, "_Q_BLOCK", 8)
+        q, k, v = self._rand(tq=20, tk=20)  # 20 % 8 != 0
+
+        g_got = jax.grad(lambda q: (A.fused_attention(
+            q, k, v, causal=True, impl="pallas") ** 2).sum())(q)
+        g_want = jax.grad(lambda q: (attention_reference(
+            q, k, v, causal=True) ** 2).sum())(q)
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                                   rtol=5e-5, atol=5e-5)
+
+    def test_fused_bwd_multiblock_accumulation(self, monkeypatch):
+        """Several q-blocks per (b*h): dk/dv accumulate across the
+        fori_loop correctly."""
+        import theanompi_tpu.ops.attention as A
+        from theanompi_tpu.parallel.sequence import attention_reference
+
+        monkeypatch.setattr(A, "_Q_BLOCK", 8)
+        q, k, v = self._rand(tq=24, tk=24)  # 3 blocks of 8
+
+        def loss(fn, *a):
+            return (fn(*a) ** 2).sum()
+
+        g_got = jax.grad(lambda q, k, v: loss(
+            lambda q, k, v: A.fused_attention(q, k, v, causal=True,
+                                              impl="pallas"), q, k, v),
+            argnums=(0, 1, 2))(q, k, v)
+        g_want = jax.grad(lambda q, k, v: loss(
+            lambda q, k, v: attention_reference(q, k, v, causal=True),
+            q, k, v), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)
+
+    def test_fused_bwd_fully_masked_rows(self):
+        """A q row preceding every k position (fully masked): lse
+        saturates in fp32, and the bwd's re-normalization must still
+        reproduce the XLA VJP's uniform-row gradients."""
+        import jax.numpy as jnp
+
+        import theanompi_tpu.ops.attention as A
+
+        q, k, v = self._rand(tq=8, tk=16)
+        q_pos = jnp.arange(8)          # rows 0.. precede k_pos 8..
+        k_pos = 8 + jnp.arange(16)     # -> ALL rows fully masked
+        g = jax.random.normal(jax.random.key(3), q.shape)
+        scale = q.shape[-1] ** -0.5
+        _, lse = A._pallas_attention(q, k, v, q_pos, k_pos, scale,
+                                     True, interpret=True)
+        got = A._pallas_attention_bwd(q, k, v, q_pos, k_pos, lse, g,
+                                      scale, True, interpret=True)
+        want = A._xla_bwd(q, k, v, q_pos, k_pos, scale, True, g)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)

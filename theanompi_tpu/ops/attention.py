@@ -17,9 +17,11 @@ Scope notes:
   framework runs attention at: GLOBAL long context is the ring/
   Ulysses layer's job (parallel/sequence.py), and what each device
   sees locally is exactly this kernel's shape.
-* Backward is the standard analytic attention VJP composed from XLA
-  einsums (recompute-from-inputs, no residual score matrix) — fusing
-  the bwd too is a further step, not a correctness need.
+* Backward is ALSO fused (flash-style): the fwd emits the per-row
+  logsumexp, and the bwd kernel recomputes p from (q, k, lse) block
+  by block, accumulating dk/dv in fp32 VMEM scratch — the (Tq, Tk)
+  matrix never exists outside VMEM in either direction.  Ragged
+  q-blocks or oversize shapes fall back to the composed-XLA VJP.
 * ``impl='auto'``: Pallas on TPU, XLA elsewhere; force with
   ``THEANOMPI_TPU_ATTN_IMPL=pallas|xla`` (interpret mode makes the
   Pallas path unit-testable on the CPU mesh, tests/test_ops.py).
@@ -56,8 +58,8 @@ def causal_mask(q_pos, k_pos):
     return q_pos[:, None] >= k_pos[None, :]          # (Tq, Tk)
 
 
-def _kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, *, scale,
-            causal):
+def _kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, lse_ref, *,
+            scale, causal):
     q = q_ref[0]                                      # (TQ, D)
     k = k_ref[0]                                      # (TK, D)
     s = jax.lax.dot_general(
@@ -73,6 +75,7 @@ def _kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, o_ref, *, scale,
         p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)                       # (TQ, 1) fp32
 
 
 def _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
@@ -91,7 +94,7 @@ def _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
     tq_blk = min(_Q_BLOCK, tq)
     grid = (bh, pl.cdiv(tq, tq_blk))
     kern = functools.partial(_kernel, scale=scale, causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -106,12 +109,20 @@ def _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
             pl.BlockSpec((1, tk), lambda i, j: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, tq_blk, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, tq_blk, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq_blk, 1), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf, qp, kp)
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return (out.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
+            lse.reshape(bh, tq, 1))
 
 
 def _xla_attention(q, k, v, q_pos, k_pos, scale, causal):
@@ -132,6 +143,21 @@ def _fits_vmem(tq, tk, d, dtype) -> bool:
     return need <= _VMEM_BUDGET_BYTES
 
 
+def _fits_vmem_bwd(tq, tk, d, dtype) -> bool:
+    """The fused bwd holds whole Q/G/dq plus K/V/dk/dv per (b*h),
+    fp32 copies of K/V (kmat/vmat), fp32 dk/dv scratch, and per-block
+    fp32 casts of q/g."""
+    itemsize = jnp.dtype(dtype).itemsize
+    tq_blk = min(_Q_BLOCK, tq)
+    need = (3 * tq * d * itemsize          # Q, G, dq
+            + 4 * tk * d * itemsize        # K, V, dk, dv
+            + 2 * tk * d * 4               # kmat/vmat fp32 copies
+            + 2 * tk * d * 4               # fp32 dk/dv scratch
+            + 2 * tq_blk * d * 4           # q/g block fp32 casts
+            + 3 * tq_blk * tk * 4)         # s/p + dp/ds blocks
+    return need <= _VMEM_BUDGET_BYTES
+
+
 def _resolve_impl(impl: str | None, q, k) -> str:
     impl = impl or os.environ.get("THEANOMPI_TPU_ATTN_IMPL", "auto")
     if impl not in ("auto", "pallas", "xla"):
@@ -144,23 +170,109 @@ def _resolve_impl(impl: str | None, q, k) -> str:
     return impl
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _fused(q, k, v, q_pos, k_pos, scale, causal, interpret):
-    return _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
-                             interpret)
+def _bwd_kernel(q_ref, k_ref, v_ref, qpos_ref, kpos_ref, g_ref, lse_ref,
+                dq_ref, dk_ref, dv_ref, dk_s, dv_s, *, scale, causal,
+                tq_blk):
+    """Flash-style backward for one (batch*head): loop q-blocks,
+    recompute p from (q, k, lse) — no stored score matrix anywhere —
+    accumulating dk/dv in fp32 VMEM scratch."""
+    kmat = k_ref[0].astype(jnp.float32)               # (TK, D)
+    vmat = v_ref[0].astype(jnp.float32)
+    dk_s[...] = jnp.zeros_like(dk_s)
+    dv_s[...] = jnp.zeros_like(dv_s)
+    n_blocks = q_ref.shape[1] // tq_blk
+
+    def body(i, _):
+        sl = pl.ds(i * tq_blk, tq_blk)
+        q = q_ref[0, sl].astype(jnp.float32)          # (TQB, D)
+        g = g_ref[0, sl].astype(jnp.float32)
+        lse = lse_ref[0, sl]                          # (TQB, 1)
+        s = jax.lax.dot_general(
+            q, kmat, (((1,), (1,)), ((), ()))) * scale
+        if causal:
+            mask = qpos_ref[sl] >= kpos_ref[:]        # (TQB,1)>=(1,TK)
+            s = jnp.where(mask, s, _MASK_NEG)
+        p = jnp.exp(s - lse)
+        # re-normalize: a no-op (sum==1) for ordinary rows, but a
+        # FULLY-masked row saturates lse to _MASK_NEG in fp32 and
+        # exp(s-lse)=1 everywhere — the divide restores the uniform
+        # 1/Tk distribution the forward actually produced there
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        dv_s[...] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())))           # p^T g (TK, D)
+        dp = jax.lax.dot_general(
+            g, vmat, (((1,), (1,)), ((), ())))        # g v^T (TQB, TK)
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        dq_ref[0, sl] = (jax.lax.dot_general(
+            ds, kmat, (((1,), (0,)), ((), ()))) * scale
+        ).astype(dq_ref.dtype)
+        dk_s[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ()))) * scale  # ds^T q (TK, D)
+        return 0
+
+    jax.lax.fori_loop(0, n_blocks, body, 0)
+    dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+    dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
-def _fused_fwd(q, k, v, q_pos, k_pos, scale, causal, interpret):
-    out = _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
-                            interpret)
-    return out, (q, k, v, q_pos, k_pos)
+def _pallas_attention_bwd(q, k, v, q_pos, k_pos, lse, g, scale, causal,
+                          interpret):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bh = b * h
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, x.shape[1], d)
+
+    qf, kf, vf, gf = fold(q), fold(k), fold(v), fold(g)
+    qp = q_pos.astype(jnp.int32).reshape(tq, 1)
+    kp = k_pos.astype(jnp.int32).reshape(1, tk)
+    tq_blk = min(_Q_BLOCK, tq)
+
+    whole = lambda i: (i, 0, 0)  # noqa: E731
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, causal=causal,
+                          tq_blk=tq_blk),
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((tq, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq, 1), whole, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk, d), whole, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tk, d), jnp.float32),
+            pltpu.VMEM((tk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, qp, kp, gf, lse)
+
+    def unfold(x, t):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    return unfold(dq, tq), unfold(dk, tk), unfold(dv, tk)
 
 
-def _fused_bwd(scale, causal, interpret, res, g):
-    """Analytic attention VJP (recompute p from inputs):
-    dv = p^T g;  ds = p * (dp - rowsum(dp*p)),  dp = g v^T;
-    dq = ds k * scale;  dk = ds^T q * scale."""
-    q, k, v, q_pos, k_pos = res
+def _xla_bwd(q, k, v, q_pos, k_pos, scale, causal, g):
+    """Composed-XLA VJP (recompute p from inputs): dv = p^T g;
+    ds = p * (dp - rowsum(dp*p)), dp = g v^T; dq = ds k * scale;
+    dk = ds^T q * scale.  Fallback when the Pallas bwd's VMEM/blocking
+    premises don't hold."""
     s = block_scores(q, k, scale)
     if causal:
         s = jnp.where(causal_mask(q_pos, k_pos)[None, None], s, _MASK_NEG)
@@ -173,6 +285,33 @@ def _fused_bwd(scale, causal, interpret, res, g):
           * scale).astype(q.dtype)
     dk = (jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
           * scale).astype(k.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _fused(q, k, v, q_pos, k_pos, scale, causal, interpret):
+    out, _ = _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
+                               interpret)
+    return out
+
+
+def _fused_fwd(q, k, v, q_pos, k_pos, scale, causal, interpret):
+    out, lse = _pallas_attention(q, k, v, q_pos, k_pos, scale, causal,
+                                 interpret)
+    return out, (q, k, v, q_pos, k_pos, lse)
+
+
+def _fused_bwd(scale, causal, interpret, res, g):
+    q, k, v, q_pos, k_pos, lse = res
+    tq = q.shape[1]
+    # the fused bwd loops exact q-blocks; ragged tails or oversize
+    # VMEM needs take the composed-XLA path instead
+    if tq % min(_Q_BLOCK, tq) == 0 and _fits_vmem_bwd(
+            tq, k.shape[1], q.shape[-1], q.dtype):
+        dq, dk, dv = _pallas_attention_bwd(q, k, v, q_pos, k_pos, lse,
+                                           g, scale, causal, interpret)
+    else:
+        dq, dk, dv = _xla_bwd(q, k, v, q_pos, k_pos, scale, causal, g)
     return dq, dk, dv, None, None
 
 
