@@ -29,6 +29,9 @@ def main() -> int:
     ap.add_argument("--snapshot-dir", default="/tmp/tm_multihost_snap")
     ap.add_argument("--checkpoint", action="store_true")
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1: optimizer state sharded over 'data' "
+                         "across the process boundary")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -69,7 +72,8 @@ def main() -> int:
             super().train_metrics(loss, error, n_images)
 
     cfg = ModelConfig(batch_size=8, n_epochs=100, learning_rate=0.05,
-                      print_freq=0, snapshot_dir=args.snapshot_dir)
+                      print_freq=0, snapshot_dir=args.snapshot_dir,
+                      zero_sharding=args.zero)
     devs = jax.devices()
     mesh = data_mesh(len(devs), devs)
     model = SmallCifar(config=cfg, mesh=mesh, verbose=False)

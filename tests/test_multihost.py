@@ -121,3 +121,21 @@ def test_two_process_checkpoint_resume(workdir):
     np.testing.assert_allclose(np.array(resumed[0]["losses"]),
                                np.array(cont[0]["losses"])[n:],
                                rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_zero_sharding_matches_plain(workdir):
+    """ZeRO-1 across the process boundary: psum_scatter + all_gather
+    ride the gloo/DCN collectives, the sharded optimizer state spans
+    both processes' devices, and the loss curve matches plain BSP."""
+    zero = _run_procs(2, port=45717, outdir=workdir, devices_per_proc=4,
+                      epochs=1, extra=["--zero"])
+    plain = _run_procs(2, port=45718, outdir=workdir, devices_per_proc=4,
+                       epochs=1)
+    lz = np.array(zero[0]["losses"])
+    lp = np.array(plain[0]["losses"])
+    assert len(lz) == len(lp) > 0
+    # elementwise-optimizer ZeRO is step-equal to plain BSP
+    np.testing.assert_allclose(lz, lp, rtol=1e-4, atol=1e-6)
+    # both ranks agree with each other
+    np.testing.assert_allclose(lz, np.array(zero[1]["losses"]), rtol=1e-6)
