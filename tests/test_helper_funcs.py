@@ -78,3 +78,18 @@ def test_data_mesh_overrequest_raises(devices8):
 
     with pytest.raises(ValueError):
         data_mesh(1024)
+
+
+def test_recorder_reports_tflops_when_model_declares_flops():
+    from theanompi_tpu.utils.recorder import Recorder
+
+    r = Recorder(rank=1, size=4, print_freq=0, flops_per_sample=12.3e9)
+    r.train_metrics(1.0, 0.5, 4000)
+    r._epoch_start -= 10.0  # pretend 10s of wall
+    rec = r.epoch_summary(0)
+    # 4000 img / 10 s / 4 shards * 12.3 GF = 1.23 TF/s per shard
+    assert rec["tflops_per_shard"] == 1.23
+    # column omitted when the model declares nothing
+    r2 = Recorder(rank=1, size=4, print_freq=0)
+    r2.train_metrics(1.0, 0.5, 4000)
+    assert r2.epoch_summary(0)["tflops_per_shard"] is None

@@ -163,6 +163,10 @@ class TpuModel:
     #: how batches land on the mesh; None = leading dim over 'data'.
     #: Sequence-parallel models override (e.g. P('data', 'seq')).
     batch_partition = None
+    #: trained FLOPs per sample (fwd+bwd, ~3x fwd) — models that know
+    #: theirs set it so the recorder's epoch records carry achieved
+    #: TFLOP/s (utils/recorder.py); None = column omitted
+    train_flops_per_sample: float | None = None
 
     def __init__(self, config: ModelConfig | None = None, mesh=None,
                  verbose: bool = True, shard_rank: int = 0,

@@ -27,11 +27,13 @@ class VGG19(VGG16):
 class ResNet101(ResNet50):
     name = "resnet101"
     stage_sizes = (3, 4, 23, 3)
+    train_flops_per_sample = 23.4e9   # ~7.8 GF fwd @224 x ~3
 
 
 class ResNet152(ResNet101):
     name = "resnet152"
     stage_sizes = (3, 8, 36, 3)
+    train_flops_per_sample = 34.5e9   # ~11.5 GF fwd @224 x ~3
 
 
 class ResNet50_LargeBatch(ResNet50):

@@ -134,6 +134,8 @@ class ResNet(nn.Module):
 class ResNet50(TpuModel):
     name = "resnet50"
     stage_sizes = (3, 4, 6, 3)   # zoo variants (101/152) override this
+    #: ~4.1 GFLOP fwd @224 x ~3 for fwd+bwd
+    train_flops_per_sample = 12.3e9
 
     @classmethod
     def default_config(cls) -> ModelConfig:

@@ -177,7 +177,9 @@ class EASGD(_AsyncRule):
         n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
                                                                start_epoch + max_epochs)
         recorders = [Recorder(rank=i, size=len(devs),
-                              print_freq=cfg.print_freq)
+                              print_freq=cfg.print_freq,
+                              flops_per_sample=models[
+                                  i].train_flops_per_sample)
                      for i in range(len(models))]
         epoch_done = threading.Semaphore(0)
 
@@ -228,7 +230,9 @@ class EASGD(_AsyncRule):
         # rank 0 so the per-epoch summary prints; worker recorders are
         # never touched from this thread
         val_recorder = Recorder(rank=0, size=len(devs),
-                                print_freq=cfg.print_freq)
+                                print_freq=cfg.print_freq,
+                                flops_per_sample=self.model
+                                .train_flops_per_sample)
         val_results: list[dict] = []
 
         def orchestrate(abort: threading.Event):
@@ -328,7 +332,9 @@ class ASGD(_AsyncRule):
         n_epochs = cfg.n_epochs if max_epochs is None else min(
             cfg.n_epochs, start_epoch + max_epochs)
         recorders = [Recorder(rank=i, size=len(devs),
-                              print_freq=cfg.print_freq)
+                              print_freq=cfg.print_freq,
+                              flops_per_sample=models[
+                                  i].train_flops_per_sample)
                      for i in range(len(models))]
 
         def make_worker(rank: int):
@@ -447,7 +453,9 @@ class GOSGD(_AsyncRule):
                 raise ValueError("n_total_workers/rank_offset need "
                                  "server_addr (the shared gossip hub)")
             hub = GossipHub(n)
-        recorders = [Recorder(rank=i, size=n, print_freq=cfg.print_freq)
+        recorders = [Recorder(rank=i, size=n, print_freq=cfg.print_freq,
+                              flops_per_sample=models[
+                                  i].train_flops_per_sample)
                      for i in range(n)]
         # gossip weights (global invariant: sum over ALL workers == 1)
         weights = [1.0 / n_total] * n

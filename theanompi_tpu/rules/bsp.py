@@ -37,7 +37,8 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
     host = model.host_rank
     recorder = recorder or Recorder(
         rank=host, size=model.n_workers, print_freq=cfg.print_freq,
-        save_dir=cfg.snapshot_dir if host == 0 else None)
+        save_dir=cfg.snapshot_dir if host == 0 else None,
+        flops_per_sample=model.train_flops_per_sample)
     profiler = StepProfiler(profile_dir)
     model.compile_iter_fns(sync_type)
 

@@ -57,11 +57,16 @@ class Recorder:
     SECTIONS = ("calc", "comm", "wait", "load")
 
     def __init__(self, rank: int = 0, size: int = 1,
-                 print_freq: int = 40, save_dir: str | None = None):
+                 print_freq: int = 40, save_dir: str | None = None,
+                 flops_per_sample: float | None = None):
         self.rank = rank
         self.size = size
         self.print_freq = print_freq
         self.save_dir = save_dir
+        #: trained FLOPs per sample (model-declared) — lets the epoch
+        #: record report achieved TFLOP/s per shard, the honest input
+        #: to any MFU claim (docs/DESIGN.md's measured denominators)
+        self.flops_per_sample = flops_per_sample
         self._t0: float | None = None
         self.epoch_time: dict[str, float] = defaultdict(float)
         self.all_time: dict[str, float] = defaultdict(float)
@@ -124,6 +129,10 @@ class Recorder:
             "epoch": epoch,
             "wall_time_s": round(wall, 3),
             "images_per_sec": round(self.n_images / wall, 2) if wall > 0 else 0.0,
+            "tflops_per_shard": (
+                round(self.n_images / wall / max(self.size, 1)
+                      * self.flops_per_sample / 1e12, 2)
+                if wall > 0 and self.flops_per_sample else None),
             "train_loss": float(np.mean(self.train_losses)) if self.train_losses else None,
             "train_error": float(np.mean(self.train_errors)) if self.train_errors else None,
             "val_loss": None if val_loss is None else float(val_loss),
