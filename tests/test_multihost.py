@@ -139,3 +139,30 @@ def test_two_process_zero_sharding_matches_plain(workdir):
     np.testing.assert_allclose(lz, lp, rtol=1e-4, atol=1e-6)
     # both ranks agree with each other
     np.testing.assert_allclose(lz, np.array(zero[1]["losses"]), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_zero_checkpoint_resume(workdir):
+    """Checkpointing a cross-process-SHARDED optimizer state: Orbax
+    writes each process's addressable shards (no single host can fetch
+    the whole array), and resume restores into the same sharding.
+    1 epoch + checkpoint, then 1 more from resume == 2 continuous."""
+    d = os.path.join(workdir, "zero_resume")
+    os.makedirs(d, exist_ok=True)
+    cont = _run_procs(2, port=45721, outdir=d, devices_per_proc=4,
+                      epochs=2, extra=["--zero", "--checkpoint"])
+    d2 = os.path.join(workdir, "zero_resume2")
+    os.makedirs(d2, exist_ok=True)
+    first = _run_procs(2, port=45722, outdir=d2, devices_per_proc=4,
+                       epochs=1, extra=["--zero", "--checkpoint"])
+    second = _run_procs(2, port=45723, outdir=d2, devices_per_proc=4,
+                        epochs=1, extra=["--zero", "--checkpoint",
+                                         "--resume"])
+    # the resumed epoch-2 losses equal the continuous run's epoch 2
+    lc = np.array(cont[0]["losses"])
+    l1 = np.array(first[0]["losses"])
+    l2 = np.array(second[0]["losses"])
+    n = len(l1)
+    np.testing.assert_allclose(l1, lc[:n], rtol=1e-6)
+    np.testing.assert_allclose(l2, lc[n:n + len(l2)], rtol=1e-5,
+                               atol=1e-7)

@@ -523,6 +523,13 @@ class TpuModel:
         if stack > 1:
             host_iter = _stack_host_batches(host_iter, stack)
             n_iters -= n_iters % stack
+            if n_iters == 0:
+                raise ValueError(
+                    f"the epoch has fewer iterations than the stacked "
+                    f"cadence ({stack} = max(steps_per_call, "
+                    f"grad_accum_steps)) — every epoch would train "
+                    f"NOTHING; shrink the stack or grow the dataset/"
+                    f"batch ratio")
             spec = self.stacked_batch_spec()
         self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh,
                                                   spec=spec)

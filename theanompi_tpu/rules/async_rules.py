@@ -84,6 +84,11 @@ class _AsyncRule(Rule):
             raise ValueError(
                 "grad_accum_steps>1 is a BSP feature; the async rules' "
                 "exchange cadence is per-iteration")
+        if getattr(cfg, "zero_sharding", False):
+            raise ValueError(
+                "zero_sharding is a BSP feature; async workers own "
+                "1-device meshes where a data-axis shard is the whole "
+                "state (no memory win, silently misleading)")
         models = []
         for i, dev in enumerate(devs):
             m = cls(config=config, mesh=data_mesh(1, [dev]),
@@ -179,7 +184,8 @@ class EASGD(_AsyncRule):
         recorders = [Recorder(rank=i, size=len(devs),
                               print_freq=cfg.print_freq,
                               flops_per_sample=models[
-                                  i].train_flops_per_sample)
+                                  i].train_flops_per_sample,
+                              images_are_global=False)
                      for i in range(len(models))]
         epoch_done = threading.Semaphore(0)
 
@@ -232,7 +238,8 @@ class EASGD(_AsyncRule):
         val_recorder = Recorder(rank=0, size=len(devs),
                                 print_freq=cfg.print_freq,
                                 flops_per_sample=self.model
-                                .train_flops_per_sample)
+                                .train_flops_per_sample,
+                                images_are_global=False)
         val_results: list[dict] = []
 
         def orchestrate(abort: threading.Event):
@@ -334,7 +341,8 @@ class ASGD(_AsyncRule):
         recorders = [Recorder(rank=i, size=len(devs),
                               print_freq=cfg.print_freq,
                               flops_per_sample=models[
-                                  i].train_flops_per_sample)
+                                  i].train_flops_per_sample,
+                              images_are_global=False)
                      for i in range(len(models))]
 
         def make_worker(rank: int):
@@ -455,7 +463,8 @@ class GOSGD(_AsyncRule):
             hub = GossipHub(n)
         recorders = [Recorder(rank=i, size=n, print_freq=cfg.print_freq,
                               flops_per_sample=models[
-                                  i].train_flops_per_sample)
+                                  i].train_flops_per_sample,
+                              images_are_global=False)
                      for i in range(n)]
         # gossip weights (global invariant: sum over ALL workers == 1)
         weights = [1.0 / n_total] * n

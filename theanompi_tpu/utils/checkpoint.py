@@ -59,15 +59,21 @@ class Checkpointer:
                 f"failed: {e}") from e
 
     def save(self, epoch: int, payload: PyTree, force: bool = False) -> None:
-        # Move to host numpy so the checkpoint is device-layout
-        # agnostic (sharded ZeRO/TP states materialize their global
-        # arrays here) — this snapshot is what makes the async write
-        # safe against further training mutating the state.
         self._fence()  # fence any in-flight write
+
         # np.array (not asarray): device arrays copy either way, but a
         # host-numpy payload must ALSO be copied or the async write
-        # races with caller mutations
-        payload = jax.tree.map(lambda l: np.array(l), payload)
+        # races with caller mutations.  Arrays spanning non-addressable
+        # devices (ZeRO/TP state under multi-controller) CANNOT be
+        # fetched to one host — leave them as jax.Arrays; Orbax saves
+        # distributed arrays natively (every process calls save, each
+        # writing its addressable shards).
+        def snap(l):
+            if isinstance(l, jax.Array) and not l.is_fully_addressable:
+                return l
+            return np.array(l)
+
+        payload = jax.tree.map(snap, payload)
         self._mgr.save(epoch, args=ocp.args.StandardSave(payload), force=force)
         if not self.async_save:
             self._mgr.wait_until_finished()
@@ -89,24 +95,19 @@ class Checkpointer:
         if epoch is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         if like is not None:
-            like = jax.tree.map(np.asarray, like)
+            # distributed template leaves keep their sharding so the
+            # restore lands shard-by-shard on each process
+            like = jax.tree.map(
+                lambda l: l if (isinstance(l, jax.Array)
+                                and not l.is_fully_addressable)
+                else np.asarray(l), like)
             return self._mgr.restore(epoch, args=ocp.args.StandardRestore(like))
         return self._mgr.restore(epoch)
 
     def close(self) -> None:
-        # Close runs in the rules' finally blocks: if an exception is
-        # already propagating there, a background-write failure here
-        # must not MASK it — report and let the original through.
-        import sys
-
-        propagating = sys.exc_info()[1] is not None
-        try:
-            self._fence()
-            self._mgr.close()
-        except Exception as e:
-            if propagating:
-                print(f"[checkpoint] close failed while another error "
-                      f"propagates (reporting, not masking): {e}",
-                      file=sys.stderr)
-                return
-            raise
+        # A failed final write is itself data loss — surface it.  When
+        # close runs in a finally during another exception's unwind,
+        # Python's implicit chaining keeps BOTH visible ('during
+        # handling of the above exception...'), so nothing is masked.
+        self._fence()
+        self._mgr.close()

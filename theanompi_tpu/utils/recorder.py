@@ -58,7 +58,8 @@ class Recorder:
 
     def __init__(self, rank: int = 0, size: int = 1,
                  print_freq: int = 40, save_dir: str | None = None,
-                 flops_per_sample: float | None = None):
+                 flops_per_sample: float | None = None,
+                 images_are_global: bool = True):
         self.rank = rank
         self.size = size
         self.print_freq = print_freq
@@ -67,6 +68,10 @@ class Recorder:
         #: record report achieved TFLOP/s per shard, the honest input
         #: to any MFU claim (docs/DESIGN.md's measured denominators)
         self.flops_per_sample = flops_per_sample
+        #: True (BSP): n_images counts the GLOBAL batch, divide by
+        #: size for the per-shard rate.  False (async rules): each
+        #: worker's recorder counts only its own images
+        self.images_are_global = images_are_global
         self._t0: float | None = None
         self.epoch_time: dict[str, float] = defaultdict(float)
         self.all_time: dict[str, float] = defaultdict(float)
@@ -130,7 +135,9 @@ class Recorder:
             "wall_time_s": round(wall, 3),
             "images_per_sec": round(self.n_images / wall, 2) if wall > 0 else 0.0,
             "tflops_per_shard": (
-                round(self.n_images / wall / max(self.size, 1)
+                round(self.n_images / wall
+                      / (max(self.size, 1) if self.images_are_global
+                         else 1)
                       * self.flops_per_sample / 1e12, 2)
                 if wall > 0 and self.flops_per_sample else None),
             "train_loss": float(np.mean(self.train_losses)) if self.train_losses else None,
