@@ -88,3 +88,24 @@ def test_sum_mode_with_scaled_lr_matches_avg(mesh8, tmp_path):
     a = r_avg["records"][0]["train_loss"]
     b = r_sum["records"][0]["train_loss"]
     assert abs(a - b) / a < 0.15, (a, b)
+
+
+def test_same_seed_identical_curve(mesh8, tmp_path):
+    """Determinism guarantee: two sessions from the same seed produce
+    bit-identical loss sequences (epoch shuffles are pure functions of
+    (seed, epoch); augment draws come from the step rng; XLA reduction
+    order is fixed for a fixed mesh)."""
+    from tests._tiny_models import TinyCifar128
+
+    def run(tag):
+        cfg = small_cfg(tmp_path, n_epochs=1, seed=123,
+                        snapshot_dir=str(tmp_path / tag))
+        m = TinyCifar128(config=cfg, mesh=mesh8, verbose=False)
+        res = run_bsp_session(m, checkpoint=False)
+        losses = [r["train_loss"] for r in res["records"]]
+        return losses, res["val"]["loss"]
+
+    l1, v1 = run("a")
+    l2, v2 = run("b")
+    assert l1 == l2          # bit-identical, not merely close
+    assert v1 == v2
