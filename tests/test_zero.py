@@ -143,3 +143,43 @@ def test_zero_rejects_bf16_strategy_and_variant_models(mesh8):
                          n_layers=1, d_model=32, n_heads=4, seq_len=16)
     with pytest.raises(ValueError, match="zero_sharding is not"):
         m.compile_iter_fns("avg")
+
+
+def test_zero_composes_with_sequence_parallel():
+    """ZeRO over (data x seq): extra axes psum plainly, the data axis
+    reduce_scatters — one step equals the plain SP step, with the
+    optimizer state sharded over 'data' only."""
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.parallel.mesh import MeshSpec, make_training_mesh
+    from theanompi_tpu.utils.recorder import Recorder
+
+    mesh = make_training_mesh(MeshSpec(data=2, seq=4), jax.devices()[:8])
+
+    def make(zero):
+        cfg = ModelConfig(batch_size=4, n_epochs=1, learning_rate=0.05,
+                          print_freq=0, weight_decay=0.0, seed=7,
+                          zero_sharding=zero)
+        return TransformerLM(config=cfg, mesh=mesh, verbose=False,
+                             n_layers=1, d_model=32, n_heads=4,
+                             seq_len=32)
+
+    losses = {}
+    for zero in (False, True):
+        m = make(zero)
+        m.compile_iter_fns("avg")
+        rec = Recorder(rank=0, size=8, print_freq=0)
+        m.begin_epoch(0)
+        for i in range(2):
+            m.train_iter(i, rec)
+        m._flush_metrics(rec)
+        losses[zero] = list(np.asarray(rec.train_losses))
+        if zero:
+            vec = [l for l in jax.tree.leaves(m.state.opt_state)
+                   if getattr(l, "ndim", 0) == 1 and l.size >= 8]
+            assert vec, "momentum vector slots expected"
+            # sharded over 'data' (2-way), replicated over 'seq'
+            assert {s.data.shape for s in vec[0].addressable_shards} \
+                == {(vec[0].shape[0] // 2,)}
+        m.cleanup()
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5,
+                               atol=1e-6)

@@ -147,7 +147,8 @@ class ModelConfig:
     #: ZeRO-1: shard the optimizer state over the data axis
     #: (parallel/zero.py — reduce_scatter grads, update the 1/N shard,
     #: all_gather params).  Step-equal to plain BSP for elementwise
-    #: optimizers; BSP over a pure data mesh only
+    #: optimizers; BSP only, composes with the seq axis (extra reduce
+    #: axes psum the gradient shard)
     zero_sharding: bool = False
     seed: int = 42
     data_dir: str | None = None
@@ -214,9 +215,10 @@ class TpuModel:
 
         cfg = self.config
         part, axes = self._batch_axes()
-        if axes != (AXIS_DATA,):
-            raise ValueError("zero_sharding composes with the pure data "
-                             f"mesh only (got reduce axes {axes})")
+        if AXIS_DATA not in axes:
+            raise ValueError("zero_sharding shards the optimizer over "
+                             f"the '{AXIS_DATA}' axis, which is not "
+                             f"among this model's reduce axes {axes}")
         if cfg.optimizer == "lars":
             raise ValueError("zero_sharding needs an ELEMENTWISE "
                              "optimizer; lars computes layerwise trust "
@@ -443,7 +445,8 @@ class TpuModel:
             self.train_step = make_bsp_zero_step(
                 self.loss_fn, self.tx, self.mesh,
                 params_template=self.state.params,  # shapes only
-                avg=(sync_type != "cdd"), batch_partition=part)
+                avg=(sync_type != "cdd"), batch_partition=part,
+                reduce_axes=axes)
             self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
                                                 batch_partition=part,
                                                 reduce_axes=axes)

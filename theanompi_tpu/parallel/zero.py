@@ -18,9 +18,11 @@ is rejected (a flat shard has no layer boundaries).
 
 The reference has no analogue (its exchanger zoo allreduced grads or
 params, SURVEY.md §2.4); this is the TPU-era completion of that zoo —
-selected as ``ModelConfig.zero_sharding=True``, BSP only.  The
-pattern is the cross-replica weight-update sharding of
-arXiv:2004.13336 (retrieved in PAPERS.md) / ZeRO stage 1.
+selected as ``ModelConfig.zero_sharding=True``, BSP only (composes
+with the ``seq`` axis: extra reduce axes psum plainly before the
+data-axis reduce_scatter).  The pattern is the cross-replica
+weight-update sharding of arXiv:2004.13336 (retrieved in PAPERS.md) /
+ZeRO stage 1.
 """
 
 from __future__ import annotations
@@ -94,34 +96,45 @@ def make_bsp_zero_step(
     avg: bool = True,
     donate: bool = True,
     batch_partition: P = P(AXIS_DATA),
+    reduce_axes: tuple[str, ...] = (AXIS_DATA,),
 ):
     """Build the ZeRO-1 training step.
 
     ``step(state, batch, rng) -> (state, metrics)`` with ``state.params``
     replicated and ``state.opt_state`` sharded over 'data' (the specs
-    come from ``init_zero_opt_state``).  Reduction is over the data
-    axis only (compose-with-seq is future work — the model layer
-    rejects other reduce axes).
+    come from ``init_zero_opt_state``).  ``reduce_axes`` must include
+    'data'; any OTHER reduce axis (e.g. 'seq' for the long-context
+    family) is psum-ed plainly before the data-axis reduce_scatter —
+    the optimizer shard stays a pure data-axis concept.
     """
+    if AXIS_DATA not in reduce_axes:
+        raise ValueError(f"zero needs the '{AXIS_DATA}' axis in "
+                         f"reduce_axes, got {reduce_axes}")
+    extra_axes = tuple(a for a in reduce_axes if a != AXIS_DATA)
     n = mesh.shape[AXIS_DATA]
+    n_total = n * int(np.prod([mesh.shape[a] for a in extra_axes] or [1]))
     total, pad, per_shard = _flat_info(params_template, n)
     _, opt_specs = _opt_specs(tx, per_shard)
     state_in_specs = TrainState(step=P(), params=P(), opt_state=opt_specs,
                                 model_state=P())
 
     def shard_step(state: TrainState, batch, rng):
-        rng = _fold_axis_rng(rng, (AXIS_DATA,))
+        rng = _fold_axis_rng(rng, reduce_axes)
         grads, new_ms, metrics = grad_and_metrics(
             loss_fn, state.params, state.model_state, batch, rng)
-        new_ms = _pmean(new_ms, (AXIS_DATA,))
+        new_ms = _pmean(new_ms, reduce_axes)
 
         gflat, _ = ravel_pytree(grads)
         gflat = jnp.pad(gflat.astype(jnp.float32), (0, pad))
-        # reduce_scatter: each shard ends with the SUM of its slice
+        # reduce_scatter FIRST: the sums commute, and psum-ing only
+        # the 1/N shard over the extra axes moves data-axis-size times
+        # less traffic than psum-ing the full vector would
         gshard = lax.psum_scatter(gflat, AXIS_DATA, scatter_dimension=0,
                                   tiled=True)
+        if extra_axes:
+            gshard = lax.psum(gshard, extra_axes)
         if avg:
-            gshard = gshard / n
+            gshard = gshard / n_total
 
         idx = lax.axis_index(AXIS_DATA)
         pflat, unravel = ravel_pytree(state.params)
@@ -136,7 +149,7 @@ def make_bsp_zero_step(
 
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt, model_state=new_ms)
-        return new_state, _pmean(metrics, (AXIS_DATA,))
+        return new_state, _pmean(metrics, reduce_axes)
 
     sharded = jax.shard_map(
         shard_step,
