@@ -223,9 +223,9 @@ class TpuModel:
             raise ValueError("zero_sharding needs an ELEMENTWISE "
                              "optimizer; lars computes layerwise trust "
                              "ratios which a flat shard cannot see")
-        if cfg.steps_per_call > 1 or cfg.grad_accum_steps > 1:
-            raise ValueError("zero_sharding does not compose with the "
-                             "stacked cadences yet")
+        if cfg.steps_per_call > 1:
+            raise ValueError("zero_sharding does not compose with "
+                             "steps_per_call (grad_accum_steps composes)")
         if cfg.exchange_what != "grads":
             raise ValueError("zero_sharding IS the gradient exchange; "
                              "exchange_what='params' does not apply")
@@ -442,11 +442,17 @@ class TpuModel:
             from theanompi_tpu.parallel.zero import make_bsp_zero_step
 
             self._check_zero_supported()
+            zero_kw = dict(avg=(sync_type != "cdd"),
+                           batch_partition=part, reduce_axes=axes)
             self.train_step = make_bsp_zero_step(
                 self.loss_fn, self.tx, self.mesh,
                 params_template=self.state.params,  # shapes only
-                avg=(sync_type != "cdd"), batch_partition=part,
-                reduce_axes=axes)
+                **zero_kw)
+            if self.config.grad_accum_steps > 1:
+                self.train_step_accum = make_bsp_zero_step(
+                    self.loss_fn, self.tx, self.mesh,
+                    params_template=self.state.params, accum=True,
+                    **zero_kw)
             self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
                                                 batch_partition=part,
                                                 reduce_axes=axes)

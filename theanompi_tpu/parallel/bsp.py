@@ -225,6 +225,29 @@ def make_bsp_multi_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def accumulate_microbatch_grads(loss_fn: LossFn, params, model_state,
+                                stacked, rng, init_gsum, add_grads):
+    """Shared accumulation scan for the grad-accum cadences (plain
+    and ZeRO): threads model_state through ``a`` microbatches with
+    per-microbatch rng folds, combining grads via ``add_grads(gsum,
+    grads_tree)``.  Returns (new_model_state, gsum, metrics_mean, a) —
+    the cadence semantics live HERE so the two step builders cannot
+    diverge."""
+    a = jax.tree.leaves(stacked)[0].shape[0]
+
+    def body(carry, xs):
+        ms, gsum = carry
+        i, mb = xs
+        grads, ms, metrics = grad_and_metrics(
+            loss_fn, params, ms, mb, jax.random.fold_in(rng, i))
+        return (ms, add_grads(gsum, grads)), metrics
+
+    (ms, gsum), metrics = jax.lax.scan(
+        body, (model_state, init_gsum), (jnp.arange(a), stacked))
+    metrics = jax.tree.map(lambda m: m.mean(axis=0), metrics)
+    return ms, gsum, metrics, a
+
+
 def make_bsp_accum_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
@@ -259,21 +282,11 @@ def make_bsp_accum_step(
 
     def shard_accum(state: TrainState, stacked, rng):
         rng = _fold_axis_rng(rng, reduce_axes)
-        a = jax.tree.leaves(stacked)[0].shape[0]
-
-        def body(carry, xs):
-            ms, gsum = carry
-            i, mb = xs
-            grads, ms, metrics = grad_and_metrics(
-                loss_fn, state.params, ms, mb, jax.random.fold_in(rng, i))
-            gsum = jax.tree.map(jnp.add, gsum, grads)
-            return (ms, gsum), metrics
-
         gz = jax.tree.map(jnp.zeros_like, state.params)
-        (new_ms, gsum), metrics = jax.lax.scan(
-            body, (state.model_state, gz), (jnp.arange(a), stacked))
+        new_ms, gsum, metrics, a = accumulate_microbatch_grads(
+            loss_fn, state.params, state.model_state, stacked, rng,
+            gz, lambda gsum, g: jax.tree.map(jnp.add, gsum, g))
         grads = jax.tree.map(lambda g: g / a, gsum)
-        metrics = jax.tree.map(lambda m: m.mean(axis=0), metrics)
 
         new_state = _exchange_grads_and_update(
             exchanger, tx, state, grads, new_ms, reduce_axes)

@@ -19,8 +19,8 @@ is rejected (a flat shard has no layer boundaries).
 The reference has no analogue (its exchanger zoo allreduced grads or
 params, SURVEY.md §2.4); this is the TPU-era completion of that zoo —
 selected as ``ModelConfig.zero_sharding=True``, BSP only (composes
-with the ``seq`` axis: extra reduce axes psum plainly before the
-data-axis reduce_scatter).  The pattern is the cross-replica
+with the ``seq`` axis — extra reduce axes psum the gradient shard —
+and with ``grad_accum_steps`` via the shared cadence scan).  The pattern is the cross-replica
 weight-update sharding of arXiv:2004.13336 (retrieved in PAPERS.md) /
 ZeRO stage 1.
 """
@@ -41,6 +41,7 @@ from theanompi_tpu.parallel.bsp import (
     TrainState,
     _fold_axis_rng,
     _pmean,
+    accumulate_microbatch_grads,
     grad_and_metrics,
 )
 from theanompi_tpu.parallel.mesh import AXIS_DATA
@@ -97,8 +98,14 @@ def make_bsp_zero_step(
     donate: bool = True,
     batch_partition: P = P(AXIS_DATA),
     reduce_axes: tuple[str, ...] = (AXIS_DATA,),
+    accum: bool = False,
 ):
     """Build the ZeRO-1 training step.
+
+    ``accum=True`` builds the grad-accumulation variant instead:
+    ``step(state, stacked_batch, rng)`` with a leading microbatch axis
+    — grads accumulate locally as the padded flat vector, then ONE
+    sharded exchange/update (ZeRO x grad-accum composition).
 
     ``step(state, batch, rng) -> (state, metrics)`` with ``state.params``
     replicated and ``state.opt_state`` sharded over 'data' (the specs
@@ -118,17 +125,12 @@ def make_bsp_zero_step(
     state_in_specs = TrainState(step=P(), params=P(), opt_state=opt_specs,
                                 model_state=P())
 
-    def shard_step(state: TrainState, batch, rng):
-        rng = _fold_axis_rng(rng, reduce_axes)
-        grads, new_ms, metrics = grad_and_metrics(
-            loss_fn, state.params, state.model_state, batch, rng)
-        new_ms = _pmean(new_ms, reduce_axes)
-
-        gflat, _ = ravel_pytree(grads)
-        gflat = jnp.pad(gflat.astype(jnp.float32), (0, pad))
-        # reduce_scatter FIRST: the sums commute, and psum-ing only
-        # the 1/N shard over the extra axes moves data-axis-size times
-        # less traffic than psum-ing the full vector would
+    def exchange_and_update(state, gflat, new_ms):
+        """The ZeRO tail, from a local padded fp32 flat gradient:
+        reduce_scatter FIRST (the sums commute, and psum-ing only the
+        1/N shard over the extra axes moves data-axis-size times less
+        traffic than psum-ing the full vector would), update the
+        shard, all_gather the params."""
         gshard = lax.psum_scatter(gflat, AXIS_DATA, scatter_dimension=0,
                                   tiled=True)
         if extra_axes:
@@ -146,15 +148,43 @@ def make_bsp_zero_step(
         new_pshard = optax.apply_updates(pshard, updates)
         new_pflat = lax.all_gather(new_pshard, AXIS_DATA, tiled=True)
         new_params = unravel(new_pflat[:total].astype(pdtype))
+        return TrainState(step=state.step + 1, params=new_params,
+                          opt_state=new_opt, model_state=new_ms)
 
-        new_state = TrainState(step=state.step + 1, params=new_params,
-                               opt_state=new_opt, model_state=new_ms)
+    def shard_step(state: TrainState, batch, rng):
+        rng = _fold_axis_rng(rng, reduce_axes)
+        grads, new_ms, metrics = grad_and_metrics(
+            loss_fn, state.params, state.model_state, batch, rng)
+        new_ms = _pmean(new_ms, reduce_axes)
+        gflat, _ = ravel_pytree(grads)
+        gflat = jnp.pad(gflat.astype(jnp.float32), (0, pad))
+        new_state = exchange_and_update(state, gflat, new_ms)
         return new_state, _pmean(metrics, reduce_axes)
 
+    def shard_accum(state: TrainState, stacked, rng):
+        # a microbatches -> ONE sharded update (ZeRO x grad-accum):
+        # grads accumulate locally as the padded flat vector (the
+        # shared cadence scan in parallel/bsp.py), then the same tail
+        # as the single-batch step
+        rng = _fold_axis_rng(rng, reduce_axes)
+
+        def add_flat(gsum, grads):
+            gflat, _ = ravel_pytree(grads)
+            return gsum + jnp.pad(gflat.astype(jnp.float32), (0, pad))
+
+        gz = jnp.zeros((total + pad,), jnp.float32)
+        new_ms, gsum, metrics, a = accumulate_microbatch_grads(
+            loss_fn, state.params, state.model_state, stacked, rng,
+            gz, add_flat)
+        new_ms = _pmean(new_ms, reduce_axes)
+        new_state = exchange_and_update(state, gsum / a, new_ms)
+        return new_state, _pmean(metrics, reduce_axes)
+
+    fn = shard_accum if accum else shard_step
+    partition = P(None, *batch_partition) if accum else batch_partition
     sharded = jax.shard_map(
-        shard_step,
-        mesh=mesh,
-        in_specs=(state_in_specs, batch_partition, P()),
+        fn, mesh=mesh,
+        in_specs=(state_in_specs, partition, P()),
         out_specs=(state_in_specs, P()),
         check_vma=False,
     )
