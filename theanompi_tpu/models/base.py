@@ -308,7 +308,7 @@ class TpuModel:
                                self.config.lr_scale_with_workers)
         self._base_lr = base_lr
 
-        self._rng = jax.random.key(self.config.seed + 1)
+        self._rng = self._epoch_rng(0)
         self.train_step = None
         self.train_step_multi = None
         self.train_step_accum = None
@@ -515,6 +515,11 @@ class TpuModel:
         (rounded down to a multiple of ``steps_per_call``)."""
         self.cleanup_iter()
         self.current_epoch = epoch
+        # re-derive the step rng as a pure function of (seed, epoch):
+        # dropout/augment draws become epoch-deterministic, so a resume
+        # at an epoch boundary replays EXACTLY the continuous run's
+        # draws (not merely statistically equivalent ones)
+        self._rng = self._epoch_rng(epoch)
         if self.multiprocess:
             host_iter = self.data.host_train_batches(
                 epoch, self.global_batch, self.host_rank, self.host_count)
@@ -558,6 +563,13 @@ class TpuModel:
         per_step = (self.batch_partition if self.batch_partition
                     is not None else P(AXIS_DATA))
         return P(None, *per_step)
+
+    def _epoch_rng(self, epoch: int):
+        """The step-rng stream for an epoch — THE single derivation
+        (init uses epoch 0, so pre-training draws match epoch 0's
+        stream)."""
+        return jax.random.fold_in(jax.random.key(self.config.seed + 1),
+                                  epoch)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
