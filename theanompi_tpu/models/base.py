@@ -147,8 +147,8 @@ class ModelConfig:
     #: ZeRO-1: shard the optimizer state over the data axis
     #: (parallel/zero.py — reduce_scatter grads, update the 1/N shard,
     #: all_gather params).  Step-equal to plain BSP for elementwise
-    #: optimizers; BSP only, composes with the seq axis (extra reduce
-    #: axes psum the gradient shard)
+    #: optimizers; BSP only, composes with the seq axis AND with
+    #: grad_accum_steps (not with steps_per_call)
     zero_sharding: bool = False
     seed: int = 42
     data_dir: str | None = None
