@@ -93,7 +93,10 @@ def main() -> int:
         print(json.dumps({
             "metric": "resnet50_imagenet_bsp_images_per_sec_per_chip",
             "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
-            "detail": {"error": f"no measurement taken — {err}"},
+            "detail": {
+                "error": f"no measurement taken — {err}; last verified "
+                         "on-chip numbers: BASELINE.md 'Measured' table",
+            },
         }))
         return 1
 
