@@ -218,3 +218,25 @@ class TestResNet50LargeBatch:
         m._flush_metrics(rec)
         assert np.isfinite(rec.train_losses).all()
         m.cleanup()
+
+
+def test_cnn_zoo_declares_flops():
+    """Every ImageNet CNN declares its trained FLOPs so the recorder's
+    TFLOP/s column is populated; values ordered sanely by depth."""
+    from theanompi_tpu.models.alex_net import AlexNet
+    from theanompi_tpu.models.googlenet import GoogLeNet
+    from theanompi_tpu.models.model_zoo import (
+        ResNet101,
+        ResNet152,
+        VGG19,
+    )
+    from theanompi_tpu.models.resnet50 import ResNet50
+    from theanompi_tpu.models.vgg16 import VGG16
+
+    flops = {c.name: c.train_flops_per_sample
+             for c in (AlexNet, GoogLeNet, VGG16, VGG19, ResNet50,
+                       ResNet101, ResNet152)}
+    assert all(v and v > 1e9 for v in flops.values()), flops
+    assert flops["resnet50"] < flops["resnet101"] < flops["resnet152"]
+    assert flops["vgg16"] < flops["vgg19"]
+    assert flops["alexnet"] < flops["googlenet"] < flops["resnet50"]

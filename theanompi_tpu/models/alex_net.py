@@ -81,6 +81,8 @@ class AlexNetCNN(nn.Module):
 
 class AlexNet(TpuModel):
     name = "alexnet"
+    #: ~0.7 GFLOP fwd @227 (one-column) x ~3 for fwd+bwd
+    train_flops_per_sample = 2.1e9
 
     @classmethod
     def default_config(cls) -> ModelConfig:

@@ -22,6 +22,7 @@ VGG19_BLOCKS = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
 class VGG19(VGG16):
     name = "vgg19"
     blocks = VGG19_BLOCKS
+    train_flops_per_sample = 58.8e9   # ~19.6 GF fwd @224 x ~3
 
 
 class ResNet101(ResNet50):
