@@ -45,12 +45,24 @@ if STEPS_PER_CALL > E2E_STEPS:
                      "or the e2e leg would run zero iterations")
 
 
-def _probe_backend(timeout_s: int = 300) -> tuple[str | None, str]:
+PROBE_WINDOW_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_S", "1800"))
+
+
+def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
     """Initialize the backend in a SUBPROCESS first: a wedged axon
     tunnel hangs ``jax.devices()`` for ~25 min before failing, which
     would look like a silent bench hang.  Returns (platform, error):
     platform is None if the backend is unusable, with the actual
-    failure mode in ``error``."""
+    failure mode in ``error``.
+
+    Retries inside an env-capped window (``THEANOMPI_TPU_BENCH_PROBE_S``,
+    default 30 min ≈ one wedge cycle): round 2's single 300 s attempt
+    zeroed the round's official record on a transient wedge.  Killing a
+    hung client early can itself re-wedge the pool lease, so each
+    attempt gets the full remaining window — a healthy tunnel answers in
+    seconds, a wedged one fails UNAVAILABLE on its own at ~25 min and
+    the lease often recovers right after, which a follow-up attempt
+    catches."""
     # this image's sitecustomize pre-registers the axon plugin and
     # ignores the env var alone — apply it via jax.config like the
     # test conftest does, so JAX_PLATFORMS=cpu runs bench on CPU
@@ -58,18 +70,50 @@ def _probe_backend(timeout_s: int = 300) -> tuple[str | None, str]:
             "p = os.environ.get('JAX_PLATFORMS')\n"
             "if p: jax.config.update('jax_platforms', p)\n"
             "print(jax.devices()[0].platform)")
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return None, (f"device init did not complete within {timeout_s}s "
-                      "(wedged tunnel?)")
-    out = r.stdout.strip().splitlines()
-    if r.returncode == 0 and out:
-        return out[-1], ""
-    tail = "; ".join(r.stderr.strip().splitlines()[-3:])
-    return None, f"backend init failed (rc={r.returncode}): {tail}"
+    deadline = time.monotonic() + window_s
+    attempts = 0
+    fast_fails = identical_fails = 0
+    last_err = "no probe attempt ran"
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 1:
+            return None, (f"{last_err} — gave up after {attempts} "
+                          f"attempt(s) in a {window_s}s window")
+        attempts += 1
+        t_attempt = time.monotonic()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=remaining)
+        except subprocess.TimeoutExpired:
+            hung_s = time.monotonic() - t_attempt
+            return None, (f"device init attempt {attempts} still hung "
+                          f"after {hung_s:.0f}s at the end of the "
+                          f"{window_s}s probe window (wedged tunnel?)")
+        out = r.stdout.strip().splitlines()
+        if r.returncode == 0 and out:
+            return out[-1], ""
+        tail = "; ".join(r.stderr.strip().splitlines()[-3:])
+        err = f"backend init failed (rc={r.returncode}): {tail}"
+        # a deterministic misconfig (bad platform name, broken plugin
+        # import) fails instantly — retrying it for 30 min would burn
+        # the round's run budget; a real wedge takes ~25 min per
+        # failure, so it never trips this.  Three identical instant
+        # failures bail; messages that embed varying values (ports,
+        # pids) still bail after 5 instant failures in a row.
+        if time.monotonic() - t_attempt < 10:
+            fast_fails += 1
+            identical_fails = identical_fails + 1 if err == last_err else 1
+            if identical_fails >= 3 or fast_fails >= 5:
+                return None, (f"{err} — instant failure x{attempts}, "
+                              "not retrying (misconfig, not a wedge)")
+        else:
+            fast_fails = identical_fails = 0
+        last_err = err
+        # back off, but never sleep away the final attempt's window —
+        # the post-UNAVAILABLE recovery attempt is the whole point
+        remaining = deadline - time.monotonic()
+        time.sleep(min(30.0, max(0.0, remaining - 60.0)))
 
 
 import jax
@@ -167,10 +211,31 @@ def main() -> int:
     # looking like a pipeline bug.
     probe = next(model.data.train_batches(0, global_batch))
     probe_bytes = sum(np.asarray(a).nbytes for a in jax.tree.leaves(probe))
+
+    def fence_tree(tree):
+        # per-leaf value readback — the only fence the axon tunnel
+        # honors (block_until_ready returns early there); EVERY leaf,
+        # because the labels transfer may outlive the images'
+        for leaf in jax.tree.leaves(tree):
+            np.asarray(leaf.ravel()[-1:])
+
+    warm = shard_batch(probe, mesh)
+    fence_tree(warm)  # compile the slice kernels outside the timer
+    del warm
     t0 = time.perf_counter()
     put = shard_batch(probe, mesh)
-    np.asarray(jax.tree.leaves(put)[0].ravel()[:1])  # readback fence
+    fence_tree(put)
     h2d_s = time.perf_counter() - t0
+    # self-calibrate: the fence itself costs ~1 RTT per leaf on the
+    # tunnel; re-fencing the already-resident tree measures that cost
+    # so it can be subtracted from the transfer timing
+    t0 = time.perf_counter()
+    fence_tree(put)
+    fence_cost = time.perf_counter() - t0
+    # fence-RTT jitter can exceed a small transfer outright; an
+    # implausible (<=0) correction keeps the uncorrected upper bound
+    # rather than reporting clamp-garbage bandwidth
+    h2d_s = h2d_s - fence_cost if h2d_s > fence_cost else h2d_s
     h2d_gbps = probe_bytes / h2d_s / 1e9
     h2d_ceiling_total = global_batch / h2d_s  # img/s if H2D-serial
     del put, probe

@@ -18,6 +18,101 @@ import os
 import sys
 
 
+def _donate_race(args, mesh) -> dict:
+    """Regression for the async-save/donation seam (ADVICE r2,
+    utils/checkpoint.py): save() keeps non-fully-addressable leaves as
+    live jax.Arrays whose device buffers the NEXT donating train step
+    consumes — correctness rests on Orbax completing the device-to-host
+    copy before save() returns.  Here that contract is exercised, not
+    assumed: save a cross-process-sharded ZeRO state, immediately
+    donate its buffers through more train steps, then restore and
+    demand the pre-save values."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from theanompi_tpu.parallel.bsp import TrainState
+    from theanompi_tpu.parallel.mesh import shard_batch
+    from theanompi_tpu.parallel.zero import (
+        init_zero_opt_state,
+        make_bsp_zero_step,
+    )
+    from theanompi_tpu.utils.checkpoint import Checkpointer
+    from theanompi_tpu.utils.helper_funcs import build_optimizer
+
+    def loss_fn(params, model_state, batch, rng):
+        x, y = batch
+        pred = jnp.tanh(x @ params["w1"]) @ params["w2"] + params["b"]
+        loss = jnp.mean((pred - y) ** 2)
+        return loss, (model_state, {"loss": loss, "error": loss})
+
+    k1, k2 = jax.random.split(jax.random.key(0))
+    params = {"w1": jax.random.normal(k1, (5, 7)),
+              "w2": jax.random.normal(k2, (7, 3)),
+              "b": jnp.zeros((3,))}
+    tx = build_optimizer(0.05, optimizer="adamw", momentum=0.9,
+                         weight_decay=1e-4)
+    opt0, _ = init_zero_opt_state(tx, params, mesh)
+    warm = make_bsp_zero_step(loss_fn, tx, mesh, params, donate=False)
+    hot = make_bsp_zero_step(loss_fn, tx, mesh, params, donate=True)
+
+    rng_np = np.random.default_rng(1)
+    batch = shard_batch(
+        (rng_np.standard_normal((32, 5)).astype(np.float32),
+         rng_np.standard_normal((32, 3)).astype(np.float32)), mesh)
+    rng = jax.random.key(2)
+    state0 = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                        opt_state=opt0, model_state={})
+    state, _ = warm(state0, batch, rng)  # state0's template stays live
+
+    def shard_values(tree):
+        # logical value where one host can hold it; otherwise this
+        # host's shards keyed by global index tuple (replicas collapse
+        # to one entry; a restored leaf may come back as host numpy —
+        # indexing it with the key recovers the comparable slice)
+        def leaf_repr(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return {s.index: np.asarray(s.data)
+                        for s in leaf.addressable_shards}
+            return np.asarray(leaf)
+
+        return [leaf_repr(leaf) for leaf in jax.tree.leaves(tree)]
+
+    before = shard_values({"p": state.params, "o": state.opt_state})
+    any_global = any(isinstance(l, jax.Array) and not l.is_fully_addressable
+                     for l in jax.tree.leaves(state.opt_state))
+
+    ckpt = Checkpointer(args.snapshot_dir, async_save=True)
+    ckpt.save(0, {"params": state.params, "opt_state": state.opt_state,
+                  "model_state": {}, "epoch": 0, "step": 1})
+    # donate the just-saved buffers IMMEDIATELY — a lazy d2h copy in
+    # the async save would now read torn/garbage values
+    for _ in range(4):
+        state, _ = hot(state, batch, rng)
+    jax.block_until_ready(jax.tree.leaves(state.params)[0])
+
+    like = {"params": params, "opt_state": opt0, "model_state": {},
+            "epoch": 0, "step": 0}
+    restored = ckpt.restore(0, like=like)  # fences the background write
+    ckpt.close()
+    after = shard_values({"p": restored["params"],
+                          "o": restored["opt_state"]})
+    for b, a in zip(before, after):
+        if isinstance(b, dict):
+            for key, val in b.items():
+                if isinstance(a, dict):
+                    assert key in a, (key, sorted(a))
+                    got = a[key]
+                else:  # restored fully to host — slice out the shard
+                    got = np.asarray(a)[key]
+                np.testing.assert_allclose(got, val, rtol=0, atol=0)
+        else:
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0, atol=0)
+    return {"proc_id": args.proc_id, "donate_race_ok": True,
+            "state_spans_processes": bool(any_global)}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--proc-id", type=int, default=0)
@@ -32,6 +127,10 @@ def main() -> int:
     ap.add_argument("--zero", action="store_true",
                     help="ZeRO-1: optimizer state sharded over 'data' "
                          "across the process boundary")
+    ap.add_argument("--donate-race", action="store_true",
+                    help="regression (ADVICE r2): async-save sharded "
+                         "state, then IMMEDIATELY donate its buffers — "
+                         "the restored values must be the pre-save ones")
     args = ap.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -54,6 +153,13 @@ def main() -> int:
     from theanompi_tpu.parallel.mesh import data_mesh, is_multiprocess
     from theanompi_tpu.rules.bsp import run_bsp_session
     from theanompi_tpu.utils.recorder import Recorder
+
+    if args.donate_race:
+        devs = jax.devices()
+        out = _donate_race(args, data_mesh(len(devs), devs))
+        with open(args.out, "w") as f:
+            json.dump(out, f)
+        return 0
 
     class SmallCifar(Cifar10_model):
         def build_data(self):

@@ -1,0 +1,90 @@
+"""bench.py backend-probe retry (VERDICT r2 weak #1).
+
+Round 2's official record was zeroed by a single 300 s probe attempt
+hitting a transient tunnel wedge.  The probe now retries fast failures
+inside an env-capped window and only gives up when the window is
+exhausted; these tests drive that loop with a mocked subprocess so the
+policy is covered without a tunnel (the real-backend path is exercised
+by the driver's bench run).
+"""
+
+import subprocess
+
+import bench
+
+
+class _Result:
+    def __init__(self, rc, out="", err=""):
+        self.returncode, self.stdout, self.stderr = rc, out, err
+
+
+def test_probe_success_first_try(monkeypatch):
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: _Result(0, "axon\n"))
+    platform, err = bench._probe_backend(window_s=60)
+    assert platform == "axon" and err == ""
+
+
+def test_probe_retries_past_fast_failures(monkeypatch):
+    calls = []
+
+    def fake_run(*a, timeout=None, **k):
+        calls.append(timeout)
+        if len(calls) < 3:
+            return _Result(1, "", "UNAVAILABLE: lease wedged\n")
+        return _Result(0, "axon\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    platform, err = bench._probe_backend(window_s=3600)
+    assert platform == "axon" and err == ""
+    assert len(calls) == 3
+    # every attempt must be bounded by the remaining window, not ∞
+    assert all(t is not None and t <= 3600 for t in calls)
+
+
+def test_probe_bails_fast_on_deterministic_failure(monkeypatch):
+    """An instantly-repeating identical failure is a misconfig (bad
+    platform name, broken plugin), not a wedge — don't burn the 30 min
+    window on it."""
+    calls = []
+
+    def fake_run(*a, timeout=None, **k):
+        calls.append(1)
+        return _Result(1, "", "RuntimeError: unknown backend 'axno'\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    platform, err = bench._probe_backend(window_s=3600)
+    assert platform is None
+    assert len(calls) <= 3
+    assert "not retrying" in err
+
+
+def test_probe_gives_up_when_window_exhausted(monkeypatch):
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__(0, clock[0] + s))
+
+    def fake_run(*a, timeout=None, **k):
+        clock[0] += 20.0  # each failed attempt burns 20 s
+        return _Result(1, "", "UNAVAILABLE: pool lease\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    platform, err = bench._probe_backend(window_s=100)
+    assert platform is None
+    assert "UNAVAILABLE" in err and "attempt" in err
+
+
+def test_probe_hang_is_terminal(monkeypatch):
+    """A probe that never answers (killed at window end) must not loop:
+    the kill itself can re-wedge the lease, so one hang ends the probe."""
+
+    def fake_run(*a, timeout=None, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    platform, err = bench._probe_backend(window_s=60)
+    assert platform is None
+    assert "wedged tunnel" in err

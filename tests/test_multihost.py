@@ -142,6 +142,23 @@ def test_two_process_zero_sharding_matches_plain(workdir):
 
 
 @pytest.mark.slow
+def test_two_process_async_save_survives_donation(workdir):
+    """The async-save/donation seam (ADVICE r2): save() returns while
+    Orbax writes in the background, and the very next train step
+    DONATES the saved state's device buffers.  Each process saves its
+    cross-process-sharded ZeRO state, immediately donates, restores,
+    and asserts bit-equal pre-save values — so the Orbax contract
+    (d2h copy completes before save() returns) is tested, not assumed."""
+    d = os.path.join(workdir, "donate_race")
+    os.makedirs(d, exist_ok=True)
+    res = _run_procs(2, port=45725, outdir=d, devices_per_proc=4,
+                     extra=["--donate-race"])
+    for r in res:
+        assert r["donate_race_ok"] is True
+        assert r["state_spans_processes"] is True
+
+
+@pytest.mark.slow
 def test_two_process_zero_checkpoint_resume(workdir):
     """Checkpointing a cross-process-SHARDED optimizer state: Orbax
     writes each process's addressable shards (no single host can fetch

@@ -179,6 +179,24 @@ class TestFusedAttention:
         with pytest.raises(ValueError, match="unknown attention impl"):
             A._resolve_impl("flash", q, k)
 
+    def test_auto_routes_ragged_tq_to_xla_on_tpu(self, monkeypatch):
+        """Ragged q-tails in the Pallas FORWARD rely on out-of-range
+        block padding only ever exercised in interpret mode (ADVICE
+        r2) — on real silicon 'auto' must route them to XLA exactly
+        like the backward already does; impl='pallas' still forces
+        the kernel so interpret-mode tests keep their coverage."""
+        import jax.numpy as jnp
+
+        import theanompi_tpu.ops.attention as A
+
+        monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+        ragged = jnp.zeros((1, A._Q_BLOCK + 4, 2, 16))
+        assert A._resolve_impl("auto", ragged, ragged) == "xla"
+        exact = jnp.zeros((1, 2 * A._Q_BLOCK, 2, 16))
+        assert A._resolve_impl("auto", exact, exact) == "pallas"
+        small = jnp.zeros((1, 20, 2, 16))  # tq < _Q_BLOCK: one block
+        assert A._resolve_impl("auto", small, small) == "pallas"
+
     def test_bf16_inputs(self):
         import jax.numpy as jnp
 

@@ -42,6 +42,7 @@ def _free_port() -> int:
 @pytest.fixture()
 def local_service():
     """serve() on a background thread (same process, real sockets)."""
+    key_before = os.environ.get("THEANOMPI_TPU_SERVICE_KEY")
     port = _free_port()
     ready, stop = threading.Event(), threading.Event()
     t = threading.Thread(target=serve,
@@ -55,6 +56,12 @@ def local_service():
     except Exception:
         pass
     t.join(timeout=5)
+    # serve() exports a generated key when none was set — don't let it
+    # leak into later tests that assume the unset-key path
+    if key_before is None:
+        os.environ.pop("THEANOMPI_TPU_SERVICE_KEY", None)
+    else:
+        os.environ["THEANOMPI_TPU_SERVICE_KEY"] = key_before
 
 
 def test_remote_easgd_matches_closed_form(local_service):
@@ -124,12 +131,15 @@ def test_bad_authkey_rejected(local_service):
 
 
 @pytest.mark.slow
-def test_easgd_with_server_in_separate_process(tmp_path):
+def test_easgd_with_server_in_separate_process(tmp_path, monkeypatch):
     """EASGD converges with its center-param server in another OS
     process — the reference's server-as-own-rank topology over DCN."""
     from theanompi_tpu import EASGD
     from theanompi_tpu.models.base import ModelConfig
 
+    # both processes must share the key — an unset key would make the
+    # child service mint its own random one and auth would fail
+    monkeypatch.setenv("THEANOMPI_TPU_SERVICE_KEY", "test-dcn-key")
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -168,6 +178,40 @@ def test_easgd_with_server_in_separate_process(tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_unset_key_client_refuses(monkeypatch):
+    """No hard-coded key fallback (VERDICT r2 #6): a client without
+    THEANOMPI_TPU_SERVICE_KEY must refuse before touching the network —
+    the transport is pickle, so a well-known default key would be
+    remote code execution for anyone who can reach the port."""
+    monkeypatch.delenv("THEANOMPI_TPU_SERVICE_KEY", raising=False)
+    with pytest.raises(RuntimeError, match="THEANOMPI_TPU_SERVICE_KEY"):
+        ServiceClient("127.0.0.1:1")
+
+
+def test_unset_key_server_generates_and_exports(monkeypatch):
+    """A server with no key mints a random one and exports it so
+    same-process clients still connect; nothing uses a public default."""
+    monkeypatch.delenv("THEANOMPI_TPU_SERVICE_KEY", raising=False)
+    port = _free_port()
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve,
+                         args=("127.0.0.1", port, ready, stop), daemon=True)
+    t.start()
+    try:
+        assert ready.wait(10)
+        generated = os.environ.get("THEANOMPI_TPU_SERVICE_KEY")
+        assert generated and generated != "theanompi-tpu"
+        c = ServiceClient(f"127.0.0.1:{port}")
+        assert c.call("ping") == "pong"
+        c.call("shutdown")
+        c.close()
+        t.join(timeout=5)
+    finally:
+        # serve() exported the generated key outside monkeypatch's
+        # bookkeeping; scrub it so later tests see the unset state
+        os.environ.pop("THEANOMPI_TPU_SERVICE_KEY", None)
 
 
 def test_session_scoping_and_displacement(local_service):

@@ -14,7 +14,9 @@ ring allreduce, just with the update between the halves), identical
 update math for elementwise optimizers (sgd/momentum/adam/adamw/
 rmsprop — proven step-equal to plain BSP in tests), and m*P/N
 optimizer memory per chip.  LARS is layerwise, not elementwise, so it
-is rejected (a flat shard has no layer boundaries).
+is rejected (a flat shard has no layer boundaries) — enforced at the
+config layer (models/base.py compile_iter_fns); direct callers of this
+module must likewise pass an elementwise optimizer.
 
 The reference has no analogue (its exchanger zoo allreduced grads or
 params, SURVEY.md §2.4); this is the TPU-era completion of that zoo —
@@ -58,14 +60,33 @@ def _flat_info(params: PyTree, n_shards: int) -> tuple[int, int, int]:
 
 
 def _opt_specs(tx: optax.GradientTransformation, per_shard: int):
-    """Per-leaf PartitionSpecs for the sharded optimizer state: vector
-    slots (momentum/moments, shape (per_shard,)) live on 'data';
-    scalars (inject_hyperparams' learning_rate, counts) replicate."""
+    """Per-leaf PartitionSpecs for the sharded optimizer state, derived
+    STRUCTURALLY (ADVICE r2): ``optax.tree_map_params`` knows exactly
+    which state leaves mirror the params (momentum/moments — sharded
+    over 'data'); everything else (inject_hyperparams' learning_rate,
+    counts) replicates.  Shape matching alone would silently mis-shard
+    a replicated vector whose length happens to equal per_shard.
+
+    A param-SHAPED leaf that tree_map_params does NOT register (a
+    custom transform keeping unregistered per-param state) would be
+    replicated yet updated with shard-local values — silent divergence
+    under check_vma=False — so it is rejected instead."""
     template = jax.eval_shape(tx.init, jnp.zeros((per_shard,), jnp.float32))
-    specs = jax.tree.map(
-        lambda l: P(AXIS_DATA) if (getattr(l, "ndim", 0) == 1
-                                   and l.shape[0] == per_shard) else P(),
-        template)
+    marked = optax.tree_map_params(tx, lambda _: True, template,
+                                   transform_non_params=lambda _: False)
+    specs = jax.tree.map(lambda m: P(AXIS_DATA) if m else P(), marked)
+    suspect = [
+        leaf for m, leaf in zip(jax.tree.leaves(marked),
+                                jax.tree.leaves(template))
+        if not m and getattr(leaf, "ndim", 0) == 1
+        and leaf.shape[0] == per_shard
+    ]
+    if suspect:
+        raise ValueError(
+            f"optimizer state holds {len(suspect)} param-shaped leaf/leaves "
+            "not registered as params with optax.tree_map_params; ZeRO "
+            "cannot tell whether to shard them — use an optimizer whose "
+            "per-param state is registered (sgd/adam/adamw/rmsprop are)")
     return template, specs
 
 

@@ -68,6 +68,15 @@ class Checkpointer:
         # fetched to one host — leave them as jax.Arrays; Orbax saves
         # distributed arrays natively (every process calls save, each
         # writing its addressable shards).
+        #
+        # CONTRACT (load-bearing): Orbax's async save copies device
+        # buffers to host BEFORE save() returns — only the file I/O is
+        # backgrounded — so the caller's next train step may freely
+        # DONATE these buffers (parallel/bsp.py donate_argnums=(0,)).
+        # tests/test_multihost.py::test_two_process_async_save_survives_
+        # donation exercises exactly that seam; if an Orbax upgrade ever
+        # makes the d2h copy lazy, that test fails rather than this
+        # comment silently lying.
         def snap(l):
             if isinstance(l, jax.Array) and not l.is_fully_addressable:
                 return l
