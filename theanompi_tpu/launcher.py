@@ -91,6 +91,10 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="force a jax platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
                         "for the virtual test mesh)")
+    p.add_argument("--result-json", default=None, metavar="PATH",
+                   help="write the session result (val metrics + scalar "
+                        "rule stats, e.g. GOSGD gossip weights, EASGD "
+                        "n_exchanges) as JSON — param trees are omitted")
     if multihost:
         p.add_argument("--coordinator", required=True,
                        help="host:port of host 0 (jax.distributed)")
@@ -215,7 +219,49 @@ def _run(args, multihost: bool) -> int:
     val = result.get("val", {})
     if val:
         print("final val:", {k: round(float(v), 4) for k, v in val.items()})
+    if args.result_json:
+        # tmlauncher runs the SAME command on every host: gate like the
+        # recorder's JSONL (rules/bsp.py) so N hosts sharing a
+        # filesystem don't clobber one path with nondeterministic data
+        if multihost:
+            import jax
+
+            write = jax.process_index() == 0
+        else:
+            write = True
+        if write:
+            import json
+
+            with open(args.result_json, "w") as f:
+                json.dump(_jsonable(result), f)
     return 0
+
+
+def _jsonable(value):
+    """Scalar-only view of a rule result: val metrics, counters, gossip
+    weights survive; param/center pytrees (device or numpy arrays) are
+    dropped — the snapshot dir is the artifact channel for those."""
+    import numpy as np
+
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, dict):
+        kept = {k: v for k, v in ((k, _jsonable(v))
+                                  for k, v in value.items())
+                if v is not None}
+        # a param tree filters down to nested empty dicts — drop it
+        # entirely rather than emitting structural noise
+        return kept or None
+    if isinstance(value, (list, tuple)):
+        kept = [_jsonable(v) for v in value]
+        return kept if all(v is not None for v in kept) else None
+    if np.isscalar(value) or (hasattr(value, "shape")
+                              and getattr(value, "shape") == ()):
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return None
+    return None
 
 
 def tmlocal(argv=None) -> int:
