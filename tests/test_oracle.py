@@ -1,0 +1,172 @@
+"""Falsifiable accuracy oracles (VERDICT r2 #5).
+
+The round-2 synthetic convergence artifacts hit val error 0.000 —
+memorization of a noiseless generator proves the spine, not learning,
+and no optimization regression could ever fail it.  These oracles have
+a COMPUTABLE NONZERO floor: labels carry irreducible noise ρ, so the
+Bayes-optimal val error is the realized flipped-label fraction
+(≈ ρ·(C-1)/C).  A converged model must land ON the floor from above —
+below it the oracle leaks, stuck above it the stack (LR schedule,
+augment, BN, optimizer) regressed.  Train noise is a fixed draw
+(memorizable — train error may dip under the floor) while val draws
+are disjoint with independent noise, so memorization shows up on the
+train side only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.data.cifar10 import Cifar10_data
+from theanompi_tpu.data.imagenet import ImageNet_data
+
+
+def test_cifar_noise_floor_realized_and_disjoint():
+    d = Cifar10_data(synthetic_n=8192, label_noise=0.2, seed=3)
+    assert d.synthetic
+    # realized floor near the ρ·(C-1)/C = 0.18 expectation (binomial
+    # slack at n_val = 1024)
+    assert d.val_noise_frac == pytest.approx(0.18, abs=0.04)
+    assert d.train_noise_frac == pytest.approx(0.18, abs=0.02)
+    # val draws are disjoint from train (different images, not a split)
+    assert d.x_train.shape[0] == 8192 and d.x_val.shape[0] == 1024
+    assert not np.array_equal(d.x_train[:1024], d.x_val)
+    # the noiseless default keeps a zero floor
+    clean = Cifar10_data(synthetic_n=512, seed=3)
+    assert clean.val_noise_frac == 0.0 and clean.train_noise_frac == 0.0
+
+
+def test_imagenet_per_draw_noise_rate():
+    """Pool images recur, so ImageNet noise is re-drawn PER BATCH —
+    with a single-image pool (true label 0) the flipped fraction over
+    many draws must match ρ·(C-1)/C."""
+    d = ImageNet_data(crop=32, synthetic_n=4096, synthetic_pool=1,
+                      synthetic_store=40, label_noise=0.3, seed=5)
+    ys = np.concatenate(
+        [y for _, y in d.train_batches(epoch=0, global_batch=256)])
+    assert ys.size == 4096
+    frac = float((ys != 0).mean())
+    assert frac == pytest.approx(0.3 * 999 / 1000, abs=0.03)
+    # and the SAME image carries different labels across draws —
+    # per-draw noise is not memorizable
+    assert len(set(ys.tolist())) > 10
+
+
+def test_label_noise_refused_on_real_data(tmp_path):
+    """label_noise is a synthetic-oracle knob; silently corrupting a
+    real dataset's labels would be a training-data bug."""
+    x = np.zeros((8, 40, 40, 3), np.uint8)
+    y = np.zeros(8, np.int64)
+    np.savez(tmp_path / "train_000.npz", x=x, y=y)
+    np.savez(tmp_path / "val_000.npz", x=x, y=y)
+    with pytest.raises(ValueError, match="synthetic-oracle knob"):
+        ImageNet_data(data_dir=str(tmp_path), crop=32, label_noise=0.1)
+
+
+@pytest.mark.slow
+def test_cifar_converges_to_noise_floor(tmp_path, mesh8):
+    """The CNN stack must converge TO the floor, not through it: val
+    error within statistical slack of the realized flipped fraction.
+    A broken LR schedule / augment / BN leaves it far above; a leaky
+    oracle (val noise visible at train time) would dive below."""
+    from tests._tiny_models import NoisyTinyCifar
+    from theanompi_tpu.models.base import ModelConfig
+    from theanompi_tpu.rules.bsp import run_bsp_session
+
+    # the round-2 "modern stack" recipe (artifacts/cpu_convergence_
+    # modern reached 0.0078 clean in 10 epochs): AdamW + 2-epoch
+    # warmup into cosine + label smoothing
+    cfg = ModelConfig(batch_size=8, n_epochs=15, learning_rate=0.002,
+                      optimizer="adamw", weight_decay=0.01,
+                      lr_schedule="cosine", warmup_epochs=2,
+                      label_smoothing=0.05,
+                      print_freq=0, snapshot_dir=str(tmp_path))
+    model = NoisyTinyCifar(config=cfg, mesh=mesh8, verbose=False)
+    floor = model.data.val_noise_frac
+    assert 0.12 < floor < 0.24  # sanity: the oracle is actually noisy
+    res = run_bsp_session(model, checkpoint=False)
+    err = float(res["val"]["error"])
+    # the val noise realization is FIXED, so a Bayes-optimal model
+    # scores EXACTLY the floor; below it only by model mistakes that
+    # happen to coincide with flipped labels (tiny) — anything more
+    # means the oracle leaks.  Above: generous convergence slack.
+    # (observed: the CLI artifact run landed at floor + 0.002)
+    assert floor - 0.02 <= err <= floor + 0.075, (err, floor)
+
+
+@pytest.mark.slow
+def test_resnet_recipe_90_epochs_hits_floor(tmp_path, mesh8):
+    """The bundled 90-epoch ResNet recipe SHAPE (step decays at
+    30/60/80 + momentum + weight decay + bf16 + device augment + BN)
+    at tiny width against the per-draw ρ=0.25 oracle: after the full
+    schedule, val error must sit on the ≈0.25 floor — proving the
+    schedule trains and the oracle can fail."""
+    import dataclasses
+
+    from tests._tiny_models import TinyRecipeResNet
+    from theanompi_tpu.rules.bsp import run_bsp_session
+
+    cfg = dataclasses.replace(
+        TinyRecipeResNet.default_config(),
+        batch_size=8,              # x8 devices = global 64
+        learning_rate=0.02,        # per-batch-128 rate, linearly scaled
+        print_freq=0,
+        snapshot_dir=str(tmp_path))
+    assert cfg.n_epochs == 90 and cfg.lr_decay_epochs == (30, 60, 80)
+    model = TinyRecipeResNet(config=cfg, mesh=mesh8, verbose=False)
+    res = run_bsp_session(model, checkpoint=False)
+    err = float(res["val"]["error"])
+    # floor 0.25·999/1000; the val rng is epoch-independent, so ONE
+    # binomial realization (n_val=256 ⇒ σ≈0.027) applies to every
+    # eval; chance for an untrained net is ≈0.98
+    assert 0.25 - 0.085 <= err <= 0.25 + 0.085, err
+
+
+@pytest.mark.slow
+def test_jpeg_tree_to_training_end_to_end(tmp_path, mesh8):
+    """VERDICT r2 #5: the real-data loaders driven through an actual
+    training run — JPEG tree → npz shards → ImageNet_data → 8 BSP
+    epochs (~1 min on the 1-core host) — not just fixture
+    round-trips."""
+    import dataclasses
+    import os
+
+    PIL = pytest.importorskip("PIL")  # noqa: F841
+    from tests._tiny_models import TinyRecipeResNet
+    from tests.test_imagenet_prepare import make_jpeg_tree
+    from theanompi_tpu.data.imagenet import prepare_imagenet_from_images
+    from theanompi_tpu.rules.bsp import run_bsp_session
+
+    src = tmp_path / "raw"
+    shards = tmp_path / "shards"
+    os.makedirs(src)
+    make_jpeg_tree(str(src), n_classes=3, per_class=64, size=(40, 40))
+    classes = None
+    for prefix in ("train", "val"):
+        prepare_imagenet_from_images(
+            str(src), str(shards), prefix=prefix, store=40, shard_size=32,
+            class_to_idx=classes, workers=2)
+        if classes is None:
+            import json
+
+            with open(shards / "classes.json") as fh:
+                classes = json.load(fh)
+
+    class JpegResNet(TinyRecipeResNet):
+        def build_data(self):
+            return ImageNet_data(data_dir=str(shards), crop=32,
+                                 seed=self.config.seed,
+                                 augment_on_device=self.config.
+                                 augment_on_device)
+
+    cfg = dataclasses.replace(
+        JpegResNet.default_config(), batch_size=4, n_epochs=8,
+        learning_rate=0.005,   # per-128 rate; linear x8 workers = 0.04
+        print_freq=0, snapshot_dir=str(tmp_path))
+    model = JpegResNet(config=cfg, mesh=mesh8, verbose=False)
+    assert not model.data.synthetic
+    res = run_bsp_session(model, checkpoint=False)
+    # 3 solid-color classes: a working loader+train path separates
+    # them quickly (chance error ≈ 0.67)
+    assert float(res["val"]["error"]) < 0.34, res["val"]

@@ -157,7 +157,8 @@ class ImageNet_data(Dataset):
                  seed: int = 0, synthetic_n: int = 8192,
                  synthetic_pool: int = 256, synthetic_store: int = 256,
                  readahead_depth: int = 2,
-                 augment_on_device: bool = False):
+                 augment_on_device: bool = False,
+                 label_noise: float = 0.0):
         self.crop = crop
         self.seed = seed
         self.sample_shape = (crop, crop, 3)
@@ -193,6 +194,14 @@ class ImageNet_data(Dataset):
             self._pool_x, self._pool_y = _synthetic_pool(
                 synthetic_pool, self.n_classes, synthetic_store, seed
             )
+        # falsifiable-oracle knob (VERDICT r2 #5): synthetic labels are
+        # re-flipped PER DRAW (pool images recur, so a fixed flip would
+        # be memorizable); Bayes val-error floor is ρ·(C-1)/C in
+        # expectation on every evaluation
+        self.label_noise = float(label_noise)
+        if label_noise > 0.0 and not self.synthetic:
+            raise ValueError("label_noise is a synthetic-oracle knob; "
+                             "real ImageNet shards were found and loaded")
 
     # -- shared prep ---------------------------------------------------------
 
@@ -218,6 +227,12 @@ class ImageNet_data(Dataset):
         for _ in range(n_batches):
             idx = rng.integers(0, pool, size=global_batch)
             x, y = self._pool_x[idx], self._pool_y[idx]
+            if self.label_noise > 0.0:
+                flip = rng.random(global_batch) < self.label_noise
+                y = y.copy()
+                y[flip] = rng.integers(0, self.n_classes,
+                                       size=int(flip.sum()),
+                                       dtype=np.int64).astype(y.dtype)
             if train:
                 x = self._prep_train(x, rng)
             else:
