@@ -1,0 +1,45 @@
+"""tools/conv_ladder.py — the per-shape MFU decomposition of the
+ResNet-50 step (VERDICT r2 #2).  The enumeration must reproduce the
+canonical conv cost: 4.09 GMAC = 8.2 GF (2xMAC) forward at 224², and
+its geometry must match theanompi_tpu/models/resnet50.py.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from conv_ladder import conv_gflops, resnet50_convs  # noqa: E402
+
+
+def test_enumeration_matches_canonical_flops():
+    convs = resnet50_convs(batch=1)
+    total = sum(count * conv_gflops(b, h, cin, cout, k, s)
+                for (_, b, h, cin, cout, k, s, count) in convs)
+    # canonical ResNet-50: 4.09 GMAC fwd conv cost = 8.18 GF in 2xMAC
+    # (the fc layer's 2*2048*1000 = 0.004 GF is ignored)
+    assert abs(total - 8.18) < 0.15, total
+    # 16 bottleneck blocks: 4 first-blocks (4 convs each incl. proj)
+    # + 12 repeats (3 distinct shapes, with multiplicity)
+    n_convs = sum(c[-1] for c in convs)
+    assert n_convs == 1 + 4 * 4 + 12 * 3, n_convs
+
+
+def test_flops_scale_linearly_with_batch():
+    one = sum(c[-1] * conv_gflops(*c[1:-1]) for c in resnet50_convs(1))
+    four = sum(c[-1] * conv_gflops(*c[1:-1]) for c in resnet50_convs(4))
+    assert abs(four - 4 * one) < 1e-6
+
+
+def test_s2d_stem_swaps_only_the_stem():
+    base = {c[0]: c for c in resnet50_convs(1, stem="conv7")}
+    s2d = {c[0]: c for c in resnet50_convs(1, stem="s2d")}
+    assert "stem_conv7" in base and "stem_s2d4x4" in s2d
+    assert {k for k in base if not k.startswith("stem")} == \
+           {k for k in s2d if not k.startswith("stem")}
+    # the s2d re-parameterization preserves the stem's FLOPs up to the
+    # 8/7-tap zero-padding (4*4*12 = 192 taps vs 7*7*3 = 147: x1.31)
+    g7 = conv_gflops(*base["stem_conv7"][1:-1])
+    g4 = conv_gflops(*s2d["stem_s2d4x4"][1:-1])
+    assert 1.0 < g4 / g7 < 1.45, (g7, g4)

@@ -81,8 +81,8 @@ class AlexNetCNN(nn.Module):
 
 class AlexNet(TpuModel):
     name = "alexnet"
-    #: ~0.7 GFLOP fwd @227 (one-column) x ~3 for fwd+bwd
-    train_flops_per_sample = 2.1e9
+    #: 2xMAC FLOPs: ~0.7 GMAC fwd @227 (one-column) x2, x ~3 fwd+bwd
+    train_flops_per_sample = 4.2e9
 
     @classmethod
     def default_config(cls) -> ModelConfig:

@@ -144,8 +144,8 @@ class GoogLeNetCNN(nn.Module):
 
 class GoogLeNet(TpuModel):
     name = "googlenet"
-    #: ~1.5 GFLOP fwd @224 x ~3 for fwd+bwd
-    train_flops_per_sample = 4.5e9
+    #: 2xMAC FLOPs: ~1.5 GMAC fwd @224 x2, x ~3 for fwd+bwd
+    train_flops_per_sample = 9.0e9
 
     @classmethod
     def default_config(cls) -> ModelConfig:

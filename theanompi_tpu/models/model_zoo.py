@@ -22,19 +22,19 @@ VGG19_BLOCKS = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
 class VGG19(VGG16):
     name = "vgg19"
     blocks = VGG19_BLOCKS
-    train_flops_per_sample = 58.8e9   # ~19.6 GF fwd @224 x ~3
+    train_flops_per_sample = 117.6e9  # 2xMAC: 19.6 GMAC fwd @224 x2 x ~3
 
 
 class ResNet101(ResNet50):
     name = "resnet101"
     stage_sizes = (3, 4, 23, 3)
-    train_flops_per_sample = 23.4e9   # ~7.8 GF fwd @224 x ~3
+    train_flops_per_sample = 46.8e9   # 2xMAC: 7.8 GMAC fwd @224 x2 x ~3
 
 
 class ResNet152(ResNet101):
     name = "resnet152"
     stage_sizes = (3, 8, 36, 3)
-    train_flops_per_sample = 34.5e9   # ~11.5 GF fwd @224 x ~3
+    train_flops_per_sample = 69.0e9   # 2xMAC: 11.5 GMAC fwd @224 x2 x ~3
 
 
 class ResNet50_LargeBatch(ResNet50):

@@ -58,8 +58,8 @@ class VGGCNN(nn.Module):
 
 class VGG16(TpuModel):
     name = "vgg16"
-    #: ~15.5 GFLOP fwd @224 x ~3 for fwd+bwd
-    train_flops_per_sample = 46.5e9
+    #: 2xMAC FLOPs: ~15.5 GMAC fwd @224 x2, x ~3 for fwd+bwd
+    train_flops_per_sample = 93.0e9
     blocks = VGG16_BLOCKS   # zoo variants (VGG19) override this
 
     @classmethod
