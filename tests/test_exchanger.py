@@ -103,3 +103,99 @@ def test_gosgd_merge_weighted_avg():
     merged, w = gosgd_merge(own, 1.0, recv, 3.0)
     np.testing.assert_allclose(np.asarray(merged["a"]), [0.75])
     assert float(w) == 4.0
+
+
+def _named_leaves(state):
+    from jax import tree_util as jtu
+
+    return {jtu.keystr(path): leaf
+            for path, leaf in jtu.tree_flatten_with_path(state)[0]}
+
+
+def _has_field(key: str, name: str) -> bool:
+    import re
+
+    return re.search(rf"(?<![A-Za-z_]){name}(?![A-Za-z_])", key) is not None
+
+
+def test_gosgd_scale_momentum_first_moments_only():
+    """Merge-time momentum scaling (the measured stale-momentum
+    divergence fix, docs/SCALING.md): FIRST-moment slots (adam mu)
+    scale by the receiver's share; second moments (nu), counts and
+    hyperparams are kept — shrinking nu with a stale bias-correction
+    count would inflate the next preconditioned step."""
+    import optax
+
+    from theanompi_tpu.parallel import gosgd_scale_momentum
+
+    params = {"w": jnp.ones(4), "b": jnp.ones(2)}
+    tx = optax.adamw(1e-3)
+    state = tx.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    _, state = tx.update(g, state, params)
+
+    before = _named_leaves(state)
+    after = _named_leaves(gosgd_scale_momentum(state, 0.25))
+    assert before.keys() == after.keys()
+    n_mu = n_kept = 0
+    for key, v in before.items():
+        if _has_field(key, "mu"):
+            np.testing.assert_allclose(np.asarray(after[key]),
+                                       0.25 * np.asarray(v), rtol=1e-6)
+            n_mu += 1
+        else:  # nu, count
+            np.testing.assert_allclose(np.asarray(after[key]),
+                                       np.asarray(v))
+            n_kept += 1
+    assert n_mu >= 2 and n_kept >= 3  # mu x2 leaves; nu x2 + count
+
+
+def test_gosgd_scale_momentum_through_build_optimizer():
+    """The PRODUCTION optimizer shape — inject_hyperparams(chain(...))
+    from build_optimizer — must scale its trace/mu and keep nu, count,
+    and the injected learning_rate."""
+    from theanompi_tpu.parallel import gosgd_scale_momentum
+    from theanompi_tpu.utils.helper_funcs import build_optimizer
+
+    params = {"w": jnp.ones(3)}
+    for opt, first, kept in [
+        ("sgd", "trace", "learning_rate"),
+        ("adamw", "mu", "nu"),
+    ]:
+        tx = build_optimizer(0.1, optimizer=opt, momentum=0.9,
+                             weight_decay=1e-4)
+        state = tx.init(params)
+        _, state = tx.update({"w": jnp.ones(3)}, state, params)
+        before = _named_leaves(state)
+        after = _named_leaves(gosgd_scale_momentum(state, 0.5))
+        f_keys = [k for k in before if _has_field(k, first)]
+        k_keys = [k for k in before if _has_field(k, kept)]
+        assert f_keys and k_keys, (opt, sorted(before))
+        for k in f_keys:
+            np.testing.assert_allclose(np.asarray(after[k]),
+                                       0.5 * np.asarray(before[k]),
+                                       rtol=1e-6)
+        for k in k_keys:
+            np.testing.assert_allclose(np.asarray(after[k]),
+                                       np.asarray(before[k]))
+
+
+def test_gosgd_dominant_push_resets_momentum():
+    """A push whose weight dwarfs the receiver's must effectively reset
+    the receiver's momentum (share -> 0), so the next SGD step is a
+    plain gradient at the teleported point rather than a stale kick."""
+    import optax
+
+    from theanompi_tpu.parallel import gosgd_merge, gosgd_scale_momentum
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    params = {"w": jnp.zeros(3)}
+    state = tx.init(params)
+    _, state = tx.update({"w": jnp.ones(3)}, state, params)
+
+    own_w, recv_w = 1e-6, 0.5
+    _, new_w = gosgd_merge(params, own_w, {"w": jnp.ones(3)}, recv_w)
+    scaled = gosgd_scale_momentum(state, own_w / float(new_w))
+    mom = [v for k, v in _named_leaves(scaled).items()
+           if _has_field(k, "trace")]
+    assert mom and float(jnp.abs(mom[0]).max()) < 1e-5

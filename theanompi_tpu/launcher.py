@@ -74,6 +74,12 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="EASGD elastic coefficient")
     p.add_argument("--p-push", type=float, default=0.1,
                    help="GOSGD per-iteration push probability")
+    p.add_argument("--merge-momentum", default="scale",
+                   choices=("scale", "keep"),
+                   help="GOSGD: scale momentum by the receiver's share "
+                        "on each merge (default — prevents the measured "
+                        "stale-momentum divergence over slow links, see "
+                        "docs/SCALING.md) or keep it untouched")
     p.add_argument("--server-addr", default=None,
                    help="host:port of a tmserver parameter service — runs "
                         "the async rule's server over DCN instead of "
@@ -209,7 +215,8 @@ def _run(args, multihost: bool) -> int:
     elif args.rule == "GOSGD":
         kwargs.update(p_push=args.p_push,
                       n_total_workers=args.n_total_workers,
-                      rank_offset=args.rank_offset)
+                      rank_offset=args.rank_offset,
+                      merge_momentum=args.merge_momentum)
     if args.rule != "BSP" and args.server_addr:
         kwargs.update(server_addr=args.server_addr)
         if args.session_id:

@@ -5,6 +5,7 @@ from theanompi_tpu.parallel.exchanger import (
     easgd_center_update,
     easgd_worker_update,
     gosgd_merge,
+    gosgd_scale_momentum,
 )
 from theanompi_tpu.parallel.mesh import (
     AXIS_DATA,
@@ -34,5 +35,6 @@ __all__ = [
     "replicated", "replicate", "shard_batch", "local_batch", "data_axis_size",
     "BSP_Exchanger", "easgd_worker_update", "easgd_center_update",
     "easgd_both_updates", "asgd_apply_grads", "gosgd_merge",
+    "gosgd_scale_momentum",
     "TrainState", "make_bsp_train_step", "make_bsp_eval_step",
 ]

@@ -190,3 +190,44 @@ def gosgd_merge(own: PyTree, own_w, recv: PyTree, recv_w):
         lambda a, b: (own_w * a + recv_w * b) / total, own, recv
     )
     return merged, total
+
+
+#: optimizer-state fields that hold FIRST-moment information (gradient
+#: direction memory) — the slots a gossip merge must scale.  Second
+#: moments (adam/rmsprop ``nu``) are deliberately NOT here: shrinking a
+#: curvature estimate toward zero while its bias-correction ``count``
+#: stays put would make the next preconditioned step
+#: mu_hat/sqrt(nu_hat) BLOW UP at exactly the teleported point —
+#: the opposite of the stabilization this exists for.
+_FIRST_MOMENT_FIELDS = frozenset({"trace", "mu", "mean", "momentum"})
+
+
+def gosgd_scale_momentum(opt_state: PyTree, frac: float) -> PyTree:
+    """Scale the optimizer's first-moment slots by the receiver's
+    share of a gossip merge.
+
+    The merge teleports params toward the sender when recv_w >> own_w,
+    but the local momentum buffer was accumulated along the OLD
+    trajectory — applying it unscaled at the new point is the measured
+    divergence mode of gossip over slow links (docs/SCALING.md: loss
+    5-9 vs the 2.3 random floor at momentum 0.9, stable at 0).
+    Treating momentum like params in the weighted average — with the
+    sender's (unshipped) momentum taken as zero — scales it by
+    own_w/total: a small merge barely touches it, a dominating push
+    resets it.
+
+    Slots are matched by state-field NAME (optax state namedtuples:
+    sgd/momentum ``trace``, adam/adamw ``mu``, adabelief-style
+    ``mean``); everything else — second moments, counts, injected
+    hyperparams — is kept, which is the conservative direction (``keep``
+    was the reference's raw behavior).  A cheap path-walk per message,
+    no optimizer re-initialization."""
+    from jax import tree_util as jtu
+
+    def scale(path, leaf):
+        names = {p.name for p in path if isinstance(p, jtu.GetAttrKey)}
+        if names & _FIRST_MOMENT_FIELDS:
+            return leaf * frac
+        return leaf
+
+    return jtu.tree_map_with_path(scale, opt_state)

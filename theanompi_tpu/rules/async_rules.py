@@ -35,7 +35,7 @@ import jax
 import numpy as np
 
 from theanompi_tpu.models.base import TpuModel
-from theanompi_tpu.parallel.exchanger import gosgd_merge
+from theanompi_tpu.parallel.exchanger import gosgd_merge, gosgd_scale_momentum
 from theanompi_tpu.parallel.mesh import data_mesh, replicate
 from theanompi_tpu.parallel.server import ASGDServer, EASGDServer, GossipHub
 from theanompi_tpu.parallel.service import (
@@ -434,7 +434,11 @@ class GOSGD(_AsyncRule):
                  server_addr: str | None = None,
                  n_total_workers: int | None = None,
                  rank_offset: int = 0,
-                 session_id: str | None = None, **kwargs):
+                 session_id: str | None = None,
+                 merge_momentum: str = "scale", **kwargs):
+        if merge_momentum not in ("scale", "keep"):
+            raise ValueError(f"merge_momentum must be 'scale' or 'keep', "
+                             f"got {merge_momentum!r}")
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
         self.model = models[0]
@@ -546,10 +550,23 @@ class GOSGD(_AsyncRule):
                         # merge anything gossiped to us
                         recorder.start()
                         for recv_params, recv_w in h.drain(rank):
+                            own_w = weights[rank]
                             merged, new_w = gosgd_merge(
-                                model.state.params, weights[rank],
+                                model.state.params, own_w,
                                 recv_params, recv_w)
-                            model.state = model.state.replace(params=merged)
+                            if merge_momentum == "scale" and new_w > 0:
+                                # momentum rides the same weighted
+                                # average (sender's taken as 0) — the
+                                # measured stale-momentum divergence
+                                # fix, see gosgd_scale_momentum
+                                opt = gosgd_scale_momentum(
+                                    model.state.opt_state,
+                                    own_w / new_w)
+                                model.state = model.state.replace(
+                                    params=merged, opt_state=opt)
+                            else:
+                                model.state = model.state.replace(
+                                    params=merged)
                             weights[rank] = float(new_w)
                         recorder.end("comm")
                         model.train_iter(it, recorder)
