@@ -147,8 +147,9 @@ class ModelConfig:
     #: ZeRO-1: shard the optimizer state over the data axis
     #: (parallel/zero.py — reduce_scatter grads, update the 1/N shard,
     #: all_gather params).  Step-equal to plain BSP for elementwise
-    #: optimizers; BSP only, composes with the seq axis AND with
-    #: grad_accum_steps (not with steps_per_call)
+    #: optimizers; BSP only, composes with the seq axis, with
+    #: grad_accum_steps, and with steps_per_call (the two stacked
+    #: cadences stay mutually exclusive with each other)
     zero_sharding: bool = False
     seed: int = 42
     data_dir: str | None = None
@@ -223,9 +224,6 @@ class TpuModel:
             raise ValueError("zero_sharding needs an ELEMENTWISE "
                              "optimizer; lars computes layerwise trust "
                              "ratios which a flat shard cannot see")
-        if cfg.steps_per_call > 1:
-            raise ValueError("zero_sharding does not compose with "
-                             "steps_per_call (grad_accum_steps composes)")
         if cfg.exchange_what != "grads":
             raise ValueError("zero_sharding IS the gradient exchange; "
                              "exchange_what='params' does not apply")
@@ -438,6 +436,12 @@ class TpuModel:
         """Build the jitted SPMD steps (the reference's Theano-function
         compile; ``sync_type`` 'avg' vs 'cdd' maps to exchange avg/sum)."""
         part, axes = self._batch_axes()
+        if (self.config.steps_per_call > 1
+                and self.config.grad_accum_steps > 1):
+            raise ValueError(
+                "steps_per_call and grad_accum_steps are both stacked-"
+                "batch cadences; combining them by nesting is not "
+                "supported — set one of them to 1")
         if self.config.zero_sharding:
             from theanompi_tpu.parallel.zero import make_bsp_zero_step
 
@@ -448,6 +452,11 @@ class TpuModel:
                 self.loss_fn, self.tx, self.mesh,
                 params_template=self.state.params,  # shapes only
                 **zero_kw)
+            if self.config.steps_per_call > 1:
+                self.train_step_multi = make_bsp_zero_step(
+                    self.loss_fn, self.tx, self.mesh,
+                    params_template=self.state.params, multi=True,
+                    **zero_kw)
             if self.config.grad_accum_steps > 1:
                 self.train_step_accum = make_bsp_zero_step(
                     self.loss_fn, self.tx, self.mesh,
@@ -467,12 +476,6 @@ class TpuModel:
                                               self.mesh, exchanger,
                                               batch_partition=part,
                                               reduce_axes=axes)
-        if (self.config.steps_per_call > 1
-                and self.config.grad_accum_steps > 1):
-            raise ValueError(
-                "steps_per_call and grad_accum_steps are both stacked-"
-                "batch cadences; combine them by nesting is not "
-                "supported — set one of them to 1")
         if self.config.steps_per_call > 1:
             from theanompi_tpu.parallel.bsp import make_bsp_multi_step
 

@@ -120,6 +120,7 @@ def make_bsp_zero_step(
     batch_partition: P = P(AXIS_DATA),
     reduce_axes: tuple[str, ...] = (AXIS_DATA,),
     accum: bool = False,
+    multi: bool = False,
 ):
     """Build the ZeRO-1 training step.
 
@@ -127,6 +128,14 @@ def make_bsp_zero_step(
     ``step(state, stacked_batch, rng)`` with a leading microbatch axis
     — grads accumulate locally as the padded flat vector, then ONE
     sharded exchange/update (ZeRO x grad-accum composition).
+
+    ``multi=True`` builds the ``steps_per_call`` variant (ZeRO x
+    multi-step): ``lax.scan`` of the FULL sharded step —
+    reduce_scatter + shard update + all_gather per sub-step, so the
+    trajectory is identical to k separate calls with rngs
+    ``fold_in(rng, i)`` — amortizing the per-dispatch floor k-fold
+    exactly like parallel/bsp.py's make_bsp_multi_step.  Mutually
+    exclusive with ``accum`` (the two stacked cadences always are).
 
     ``step(state, batch, rng) -> (state, metrics)`` with ``state.params``
     replicated and ``state.opt_state`` sharded over 'data' (the specs
@@ -138,6 +147,9 @@ def make_bsp_zero_step(
     if AXIS_DATA not in reduce_axes:
         raise ValueError(f"zero needs the '{AXIS_DATA}' axis in "
                          f"reduce_axes, got {reduce_axes}")
+    if accum and multi:
+        raise ValueError("accum and multi are mutually exclusive "
+                         "stacked cadences")
     extra_axes = tuple(a for a in reduce_axes if a != AXIS_DATA)
     n = mesh.shape[AXIS_DATA]
     n_total = n * int(np.prod([mesh.shape[a] for a in extra_axes] or [1]))
@@ -201,8 +213,17 @@ def make_bsp_zero_step(
         new_state = exchange_and_update(state, gsum / a, new_ms)
         return new_state, _pmean(metrics, reduce_axes)
 
-    fn = shard_accum if accum else shard_step
-    partition = P(None, *batch_partition) if accum else batch_partition
+    def shard_multi(state: TrainState, stacked, rng):
+        def body(carry, xs):
+            i, batch = xs
+            return shard_step(carry, batch, jax.random.fold_in(rng, i))
+
+        k = jax.tree.leaves(stacked)[0].shape[0]
+        return lax.scan(body, state, (jnp.arange(k), stacked))
+
+    fn = shard_accum if accum else (shard_multi if multi else shard_step)
+    partition = (P(None, *batch_partition) if (accum or multi)
+                 else batch_partition)
     sharded = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(state_in_specs, partition, P()),
