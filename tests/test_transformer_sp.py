@@ -130,3 +130,41 @@ def test_remat_trains_through_sp_spine(dp_sp_mesh):
     m._flush_metrics(rec)
     assert np.isfinite(rec.train_losses).all()
     m.cleanup()
+
+
+def test_lm_declares_trained_flops(dp_sp_mesh):
+    """The LM family reports achieved TFLOP/s like the CNN zoo: FLOPs
+    per sequence = 6·n_active·L (2xMAC, fwd+bwd; embedding/positional
+    tables excluded — gather + add, ~0 FLOPs) + the attention score/PV
+    term 12·n_layers·L²·d, computed from the REAL param count so
+    resized/TP models stay honest."""
+    from jax import tree_util as jtu
+
+    m = make_lm(dp_sp_mesh)
+    flat = jtu.tree_flatten_with_path(m.state.params)[0]
+
+    def is_table(path):
+        keys = ({getattr(k, "key", None) for k in path}
+                | {getattr(k, "name", None) for k in path})
+        return bool(keys & {"embedding", "pos_emb"})
+
+    active = sum(int(leaf.size) for p, leaf in flat if not is_table(p))
+    total = sum(int(leaf.size) for _, leaf in flat)
+    assert 0 < active < total  # the tables exist AND are excluded
+    want = 6 * active * 32 + 12 * 2 * 32 * 32 * 32
+    assert m.train_flops_per_sample == float(want)
+    m.cleanup()
+
+
+def test_lm_train_flops_discounts_experts():
+    import jax.numpy as jnp
+
+    from theanompi_tpu.models.transformer import _lm_train_flops
+
+    params = {"dense": jnp.zeros((10,)), "experts": jnp.zeros((4, 5))}
+    mask = {"dense": False, "experts": True}
+    got = _lm_train_flops(params, n_layers=1, seq_len=2, d_model=3,
+                          expert_mask=mask, n_experts=4)
+    # top-1 routing: 20 expert weights count as 20/4 active per token
+    want = 6 * (10 + 20 // 4) * 2 + 12 * 1 * 2 * 2 * 3
+    assert got == float(want)

@@ -23,6 +23,8 @@ from jax.sharding import PartitionSpec as P
 from theanompi_tpu.data.lm import SeqLM_data
 from theanompi_tpu.models import layers as L
 from theanompi_tpu.models.base import ModelConfig, TpuModel
+
+
 from theanompi_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
@@ -33,6 +35,38 @@ from theanompi_tpu.ops.attention import fused_attention
 from theanompi_tpu.parallel.sequence import (
     sequence_attention,
 )
+
+#: param-tree keys whose tensors are NOT applied as per-token matmuls —
+#: the embedding gather and the positional add contribute ~0 FLOPs, and
+#: the standard 6N convention drops them
+_NON_MATMUL_KEYS = frozenset({"embedding", "pos_emb"})
+
+
+def _lm_train_flops(params, n_layers: int, seq_len: int, d_model: int,
+                    expert_mask=None, n_experts: int = 1) -> float:
+    """Trained FLOPs per SAMPLE (= per sequence) in the 2xMAC units the
+    CNN zoo and the chip-rate probes share: the standard 6·n_active
+    per trained token (fwd 2 + bwd 4) over matmul-applied params —
+    embedding/positional tables are excluded (gather + add, ~0 FLOPs)
+    — plus the attention score/PV term 12·n_layers·L²·d the
+    param-proportional term misses.  Computed from the REAL param count
+    so CLI-resized and sharded variants stay honest; with top-1 routing
+    only 1/n_experts of each expert tensor is active per token (pass
+    the MoE's ``expert_mask``)."""
+    from jax import tree_util as jtu
+
+    flat = jtu.tree_flatten_with_path(params)[0]
+    flags = (jax.tree.leaves(expert_mask) if expert_mask is not None
+             else [False] * len(flat))
+    active = 0
+    for (path, leaf), is_exp in zip(flat, flags):
+        keys = {getattr(k, "key", None) for k in path} | \
+               {getattr(k, "name", None) for k in path}
+        if keys & _NON_MATMUL_KEYS:
+            continue
+        active += int(leaf.size) // (n_experts if is_exp else 1)
+    return float(6 * active * seq_len
+                 + 12 * n_layers * seq_len * seq_len * d_model)
 
 
 class Block(nn.Module):
@@ -166,6 +200,8 @@ class TransformerLM(TpuModel):
         self._net_cfg = dict(vocab=vocab, seq_len=seq_len, n_layers=n_layers,
                              d_model=d_model, n_heads=n_heads)
         super().__init__(*args, **kwargs)
+        self.train_flops_per_sample = _lm_train_flops(
+            self.state.params, n_layers, seq_len, d_model)
 
     def _input_dtype(self):
         return jnp.int32
@@ -360,6 +396,8 @@ class TransformerLM_PP(TpuModel):
         # state built from the sharded tree (parallel/tensor.py)
         self.state = shard_train_state(params, {}, self.mesh,
                                        self.param_specs, self.tx)
+        self.train_flops_per_sample = _lm_train_flops(
+            params, n_layers, seq_len, d_model)
         # masked-loss convention: every param NOT owned per-stage has
         # real grads on exactly one stage (embeddings on stage 0 via
         # the inject path, head/ln_f on the last via the masked loss)
@@ -608,6 +646,9 @@ class TransformerLM_MoE(TpuModel):
                                    for k in path), params)
         self.state = shard_train_state(params, {}, self.mesh,
                                        self.param_specs, self.tx)
+        self.train_flops_per_sample = _lm_train_flops(
+            params, n_layers, seq_len, d_model,
+            expert_mask=self.expert_mask, n_experts=n_experts)
 
     def _input_dtype(self):
         return jnp.int32
