@@ -39,16 +39,20 @@ def test_decode_image_resizes_and_center_crops(tmp_path):
     assert abs(int(out[..., 0].mean()) - 200) < 15
 
 
-def test_prepare_from_images_roundtrip(tmp_path):
+@pytest.mark.parametrize("shard_format", ["npy", "npz"])
+def test_prepare_from_images_roundtrip(tmp_path, shard_format):
     src = tmp_path / "raw"
     out = tmp_path / "shards"
     os.makedirs(src)
     make_jpeg_tree(str(src), n_classes=3, per_class=6)
 
     paths = prepare_imagenet_from_images(str(src), str(out), prefix="train",
-                                         store=24, shard_size=8, workers=2)
+                                         store=24, shard_size=8, workers=2,
+                                         shard_format=shard_format)
     # 18 images at shard_size 8 -> 3 shards (8+8+2)
     assert len(paths) == 3
+    suffix = ".x.npy" if shard_format == "npy" else ".npz"
+    assert all(p.endswith(suffix) for p in paths)
     with open(out / "manifest.json") as fh:
         manifest = json.load(fh)
     assert sum(manifest.values()) == 18
@@ -57,13 +61,16 @@ def test_prepare_from_images_roundtrip(tmp_path):
     assert classes == {"class_0": 0, "class_1": 1, "class_2": 2}
 
     # shards are class-mixed thanks to the prep-time shuffle
-    with np.load(paths[0]) as z:
-        assert len(set(z["y"].tolist())) > 1
+    from theanompi_tpu.data.imagenet import _load_shard
+
+    _, y0 = _load_shard(paths[0])
+    assert len(set(y0.tolist())) > 1
 
     # same tree prepared as val with the train mapping
     prepare_imagenet_from_images(str(src), str(out), prefix="val",
                                  store=24, shard_size=8,
-                                 class_to_idx=classes, workers=2)
+                                 class_to_idx=classes, workers=2,
+                                 shard_format=shard_format)
 
     # the full Dataset path consumes the shards
     ds = ImageNet_data(data_dir=str(out), crop=16)
@@ -86,8 +93,8 @@ def test_prepare_from_images_roundtrip(tmp_path):
 
 def test_prepare_rerun_removes_stale_shards(tmp_path):
     """A second prep into the same out_dir must not leave the first
-    run's higher-numbered shards (training globs {prefix}_*.npz and
-    would silently mix stale data)."""
+    run's shards — in EITHER format (training globs both and would
+    silently mix stale data)."""
     src_big = tmp_path / "raw_big"
     src_small = tmp_path / "raw_small"
     out = tmp_path / "shards"
@@ -96,16 +103,27 @@ def test_prepare_rerun_removes_stale_shards(tmp_path):
     make_jpeg_tree(str(src_big), n_classes=3, per_class=6)    # 18 imgs
     make_jpeg_tree(str(src_small), n_classes=3, per_class=2)  # 6 imgs
 
+    # first run in the legacy npz format, rerun in npy: the rerun must
+    # remove every stale npz AND leave no orphan .y.npy anywhere
     prepare_imagenet_from_images(str(src_big), str(out), prefix="train",
-                                 store=24, shard_size=8, workers=2)
+                                 store=24, shard_size=8, workers=2,
+                                 shard_format="npz")
     paths2 = prepare_imagenet_from_images(str(src_small), str(out),
                                           prefix="train", store=24,
                                           shard_size=8, workers=2)
-    on_disk = sorted(glob.glob(str(out / "train_*.npz")))
+    on_disk = sorted(glob.glob(str(out / "train_*.npz"))
+                     + glob.glob(str(out / "train_*.x.npy")))
     assert on_disk == sorted(paths2) and len(on_disk) == 1
     with open(out / "manifest.json") as fh:
         manifest = json.load(fh)
     assert sum(manifest.values()) == 6
+    # and back: a rerun in npz removes the npy pair files entirely
+    paths3 = prepare_imagenet_from_images(str(src_big), str(out),
+                                          prefix="train", store=24,
+                                          shard_size=8, workers=2,
+                                          shard_format="npz")
+    assert sorted(glob.glob(str(out / "train_*.npz"))) == sorted(paths3)
+    assert glob.glob(str(out / "train_*.npy")) == []
 
 
 def test_prepare_rejects_flat_dir(tmp_path):

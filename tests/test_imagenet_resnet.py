@@ -95,7 +95,7 @@ class TestImageNetFiles:
         mpath = tmp_path / "manifest.json"
         assert mpath.exists()
         m = json.loads(mpath.read_text())
-        assert m == {"train_0000.npz": 32, "train_0001.npz": 18}
+        assert m == {"train_0000.x.npy": 32, "train_0001.x.npy": 18}
         d = ImageNet_data(data_dir=str(tmp_path), crop=16)
         assert d.n_train == 50
 

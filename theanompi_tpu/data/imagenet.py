@@ -10,10 +10,13 @@ file into a shared buffer while the GPU trained.
 
 TPU-native inversion of each piece:
 
-* **hkl batch files → ``.npz`` shard files** (``train_*.npz`` /
-  ``val_*.npz`` with uint8 ``x`` (N,H,W,3) and int ``y``).  Same
-  pre-decoded-batch design — decode cost is paid once at preparation
-  time, the training-time loader only reads + crops.
+* **hkl batch files → shard files**: mmap-able ``train_*.x.npy`` /
+  ``*.y.npy`` pairs (uint8 ``x`` (N,H,W,3), int ``y``) — the round-3
+  default: zero decode at training time, the read-ahead thread just
+  pages rows in (measured 1.8x the npz ingest rate on one core,
+  tools/host_pipeline_probe.py) — with ``train_*.npz`` (round 1/2)
+  still read.  Same pre-decoded design either way: decode cost is paid
+  once at preparation time.
 * **rank-0 broadcast of the shuffle → seeded permutation.**  The epoch
   order is a pure function of (seed, epoch), so every host computes
   the identical order with zero communication.
@@ -114,9 +117,35 @@ def _file_size_map(data_dir: str, files: list[str]) -> dict[str, int]:
                     _SIZE_CACHE[f] = int(n)
             missing = [f for f in missing if f not in _SIZE_CACHE]
         for f in missing:
-            with np.load(f) as z:
-                _SIZE_CACHE[f] = len(z["y"])
+            _SIZE_CACHE[f] = len(_load_shard(f)[1])
     return {f: _SIZE_CACHE[f] for f in files}
+
+
+def _load_shard(path: str):
+    """Decode one shard file.  ``*.x.npy`` pairs are the mmap-able
+    format: ``np.load(mmap_mode='r')`` costs no decode and no copy —
+    the OS pages image rows in as the gather touches them — which is
+    what lets ONE host core assemble uint8 batches at device rate
+    (tools/host_pipeline_probe.py measures both formats).  ``.npz``
+    (zip container, member copy per load) remains supported.
+
+    The strided touch forces every page in NOW: this function runs in
+    the read-ahead thread, so the disk I/O still overlaps training the
+    way the npz decode did — without it the mmap would defer all I/O
+    to page faults inside the consumer's gather."""
+    if path.endswith(".x.npy"):
+        x = np.load(path, mmap_mode="r")
+        x.reshape(-1)[:: 4096].sum()  # one byte per page: prefetch
+        return x, np.load(path[: -len(".x.npy")] + ".y.npy"
+                          ).astype(np.int32)
+    with np.load(path) as z:
+        return z["x"], z["y"].astype(np.int32)
+
+
+def _shard_glob(data_dir: str, prefix: str) -> list[str]:
+    return sorted(
+        glob.glob(os.path.join(data_dir, f"{prefix}_*.npz"))
+        + glob.glob(os.path.join(data_dir, f"{prefix}_*.x.npy")))
 
 
 def _synthetic_pool(n_images: int, n_classes: int, hw: int, seed: int):
@@ -141,10 +170,11 @@ def _synthetic_pool(n_images: int, n_classes: int, hw: int, seed: int):
 
 
 class ImageNet_data(Dataset):
-    """ImageNet batches from ``.npz`` shard files, or synthetic.
+    """ImageNet batches from shard files, or synthetic.
 
-    ``data_dir`` layout: ``train_*.npz`` and ``val_*.npz``, each with
-    ``x`` uint8 (N, store, store, 3) and ``y`` int labels.  Train
+    ``data_dir`` layout: ``train_*`` and ``val_*`` shards — mmap-able
+    ``.x.npy``/``.y.npy`` pairs (the prep default) and/or ``.npz`` —
+    with ``x`` uint8 (N, store, store, 3) and ``y`` int labels.  Train
     images are randomly cropped ``store → crop`` + mirrored; val images
     are center-cropped.  File-list sharding over ``rank``/``size``
     reproduces the reference's per-rank shard lists for async rules and
@@ -179,8 +209,8 @@ class ImageNet_data(Dataset):
 
         data_dir = data_dir or os.environ.get("THEANOMPI_TPU_IMAGENET")
         if data_dir and os.path.isdir(data_dir):
-            self.train_files = sorted(glob.glob(os.path.join(data_dir, "train_*.npz")))
-            self.val_files = sorted(glob.glob(os.path.join(data_dir, "val_*.npz")))
+            self.train_files = _shard_glob(data_dir, "train")
+            self.val_files = _shard_glob(data_dir, "val")
 
         if self.train_files:
             self._file_sizes = _file_size_map(
@@ -258,14 +288,10 @@ class ImageNet_data(Dataset):
         """Stream batches across shard files with read-ahead decode.
         Leftover tail samples of each file carry into the next batch."""
 
-        def load(path):
-            with np.load(path) as z:
-                return z["x"], z["y"].astype(np.int32)
-
         buf_x: list[np.ndarray] = []
         buf_y: list[np.ndarray] = []
         buffered = 0
-        for x, y in readahead(files, load, self.readahead_depth):
+        for x, y in readahead(files, _load_shard, self.readahead_depth):
             if shuffle_rng is not None:
                 p = shuffle_rng.permutation(len(y))
                 x, y = x[p], y[p]
@@ -336,19 +362,77 @@ def _update_manifest(out_dir: str, entries: dict[str, int]) -> None:
         json.dump(manifest, fh)
 
 
+def _write_shard(out_dir: str, prefix: str, index: int,
+                 x: np.ndarray, y: np.ndarray, shard_format: str) -> str:
+    """One shard in the chosen format; returns the path training
+    discovers (for npy pairs, the ``.x.npy`` member)."""
+    base = os.path.join(out_dir, f"{prefix}_{index:04d}")
+    if shard_format == "npy":
+        np.save(base + ".x.npy", x)
+        np.save(base + ".y.npy", y)
+        return base + ".x.npy"
+    if shard_format == "npz":
+        np.savez(base + ".npz", x=x, y=y)
+        return base + ".npz"
+    raise ValueError(f"unknown shard_format {shard_format!r} "
+                     "(expected 'npy' or 'npz')")
+
+
+def _unlink_shard(path: str) -> None:
+    os.unlink(path)
+    if path.endswith(".x.npy"):
+        sibling = path[: -len(".x.npy")] + ".y.npy"
+        if os.path.exists(sibling):
+            os.unlink(sibling)
+
+
+def _remove_shards(out_dir: str, paths, manifest: bool = True) -> None:
+    """Delete shard files (incl. npy pair siblings); optionally prune
+    their manifest entries."""
+    paths = sorted(paths)
+    if not paths:
+        return
+    if manifest:
+        import json
+
+        manifest_path = os.path.join(out_dir, "manifest.json")
+        if os.path.exists(manifest_path):
+            with open(manifest_path) as fh:
+                m = json.load(fh)
+            for p in paths:
+                m.pop(os.path.basename(p), None)
+            with open(manifest_path, "w") as fh:
+                json.dump(m, fh)
+    for p in paths:
+        if os.path.exists(p):
+            _unlink_shard(p)
+
+
 def prepare_imagenet_shards(src_images: np.ndarray, src_labels: np.ndarray,
                             out_dir: str, prefix: str = "train",
-                            shard_size: int = 1024) -> list[str]:
-    """Offline prep: pack (N,H,W,3) uint8 images + labels into
-    ``{prefix}_NNNN.npz`` shard files — the rebuild's analogue of the
-    reference's hickle pre-processing scripts (SURVEY.md §2.9)."""
+                            shard_size: int = 1024,
+                            shard_format: str = "npy") -> list[str]:
+    """Offline prep: pack (N,H,W,3) uint8 images + labels into shard
+    files — the rebuild's analogue of the reference's hickle
+    pre-processing scripts (SURVEY.md §2.9).  Default format is the
+    mmap-able ``.x.npy``/``.y.npy`` pair (see ``_load_shard``: training
+    reads page in lazily with zero decode); ``shard_format='npz'``
+    keeps the round-1/2 container.  A rerun replaces the prefix's
+    previous shard set in EITHER format — training globs both, so a
+    leftover would silently inflate the dataset."""
     os.makedirs(out_dir, exist_ok=True)
-    paths = []
-    for i in range(0, len(src_labels), shard_size):
-        p = os.path.join(out_dir, f"{prefix}_{i // shard_size:04d}.npz")
-        np.savez(p, x=src_images[i:i + shard_size],
-                 y=src_labels[i:i + shard_size])
-        paths.append(p)
+    preexisting = set(_shard_glob(out_dir, prefix))
+    paths: list[str] = []
+    try:
+        for i in range(0, len(src_labels), shard_size):
+            paths.append(_write_shard(out_dir, prefix, i // shard_size,
+                                      src_images[i:i + shard_size],
+                                      src_labels[i:i + shard_size],
+                                      shard_format))
+    except BaseException:
+        _remove_shards(out_dir, set(paths) - preexisting, manifest=False)
+        raise
+    _remove_shards(out_dir, preexisting - set(paths))
     _update_manifest(out_dir, {
         os.path.basename(p): int(min(shard_size, len(src_labels) - k * shard_size))
         for k, p in enumerate(paths)})
@@ -430,7 +514,8 @@ def prepare_imagenet_from_images(src_dir: str, out_dir: str,
                                  shard_size: int = 1024,
                                  class_to_idx: dict[str, int] | None = None,
                                  workers: int = 8,
-                                 shuffle_seed: int | None = 0) -> list[str]:
+                                 shuffle_seed: int | None = 0,
+                                 shard_format: str = "npy") -> list[str]:
     """Raw image directory -> resized npz shards + manifest (VERDICT r1
     next-round #8): the full analogue of the reference's raw-JPEG hickle
     preparation.  Decodes in a thread pool (PIL releases the GIL in
@@ -459,7 +544,7 @@ def prepare_imagenet_from_images(src_dir: str, out_dir: str,
     # note the previous run's shards now, remove the leftovers only
     # AFTER the new set is complete: a mid-run failure (one corrupt
     # JPEG) must not destroy an existing good dataset
-    preexisting = set(glob.glob(os.path.join(out_dir, f"{prefix}_*.npz")))
+    preexisting = set(_shard_glob(out_dir, prefix))
     with open(os.path.join(out_dir, "classes.json"), "w") as fh:
         json.dump(class_to_idx, fh)
 
@@ -471,8 +556,8 @@ def prepare_imagenet_from_images(src_dir: str, out_dir: str,
 
     def flush():
         nonlocal fill
-        p = os.path.join(out_dir, f"{prefix}_{len(paths):04d}.npz")
-        np.savez(p, x=buf_x[:fill], y=buf_y[:fill])
+        p = _write_shard(out_dir, prefix, len(paths), buf_x[:fill],
+                         buf_y[:fill], shard_format)
         paths.append(p)
         counts[os.path.basename(p)] = fill
         fill = 0
@@ -480,28 +565,25 @@ def prepare_imagenet_from_images(src_dir: str, out_dir: str,
     decoded = _bounded_thread_map(
         lambda pl: (decode_image(pl[0], store), pl[1]), pairs,
         workers=workers, window=workers * 4)
-    for img, label in decoded:
-        buf_x[fill] = img
-        buf_y[fill] = label
-        fill += 1
-        if fill == shard_size:
+    try:
+        for img, label in decoded:
+            buf_x[fill] = img
+            buf_y[fill] = label
+            fill += 1
+            if fill == shard_size:
+                flush()
+        if fill:
             flush()
-    if fill:
-        flush()
-    # success: drop the previous run's higher-numbered shards (training
-    # globs {prefix}_*.npz and would silently mix stale data) and prune
-    # their manifest entries
-    stale = sorted(preexisting - set(paths))
-    if stale:
-        manifest_path = os.path.join(out_dir, "manifest.json")
-        if os.path.exists(manifest_path):
-            with open(manifest_path) as fh:
-                manifest = json.load(fh)
-            for p in stale:
-                manifest.pop(os.path.basename(p), None)
-            with open(manifest_path, "w") as fh:
-                json.dump(manifest, fh)
-        for p in stale:
-            os.unlink(p)
+    except BaseException:
+        # mid-run failure (one corrupt JPEG): remove THIS run's new
+        # shards so the directory still holds exactly the pre-run set —
+        # without this, a cross-format rerun would leave a partial new
+        # set beside the complete old one and training (which globs
+        # both formats) would silently train on the union
+        _remove_shards(out_dir, set(paths) - preexisting, manifest=False)
+        raise
+    # success: drop the previous run's leftover shards IN EITHER FORMAT
+    # and prune their manifest entries
+    _remove_shards(out_dir, preexisting - set(paths))
     _update_manifest(out_dir, counts)
     return paths

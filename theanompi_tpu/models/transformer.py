@@ -23,8 +23,6 @@ from jax.sharding import PartitionSpec as P
 from theanompi_tpu.data.lm import SeqLM_data
 from theanompi_tpu.models import layers as L
 from theanompi_tpu.models.base import ModelConfig, TpuModel
-
-
 from theanompi_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,

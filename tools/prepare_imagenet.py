@@ -36,6 +36,11 @@ def main(argv=None) -> int:
     ap.add_argument("--no-shuffle", action="store_true",
                     help="keep directory order (default: one global "
                          "shuffle so shards are class-mixed)")
+    ap.add_argument("--format", default="npy", choices=("npy", "npz"),
+                    dest="shard_format",
+                    help="npy (default): mmap-able .x.npy/.y.npy pairs "
+                         "— zero-decode training reads; npz: the "
+                         "round-1/2 zip container")
     args = ap.parse_args(argv)
 
     from theanompi_tpu.data.imagenet import prepare_imagenet_from_images
@@ -49,7 +54,8 @@ def main(argv=None) -> int:
         args.src_dir, args.out_dir, prefix=args.prefix, store=args.store,
         shard_size=args.shard_size, class_to_idx=class_to_idx,
         workers=args.workers,
-        shuffle_seed=None if args.no_shuffle else 0)
+        shuffle_seed=None if args.no_shuffle else 0,
+        shard_format=args.shard_format)
     dt = time.monotonic() - t0
     print(f"wrote {len(paths)} {args.prefix} shards to {args.out_dir} "
           f"in {dt:.1f}s")
