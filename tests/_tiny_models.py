@@ -80,3 +80,17 @@ class TinyRecipeResNet(ResNet50):
                              synthetic_store=40,
                              augment_on_device=self.config.augment_on_device,
                              label_noise=0.25)
+
+
+class FaultyTinyCifar(TinyCifar):
+    """Worker shard_rank==1 raises mid-epoch — exercises the async
+    rules' fail-fast abort propagation (SURVEY §5.3): every OTHER
+    worker must stop at the abort event instead of training out its
+    epochs, and the injected exception must surface from wait()."""
+
+    fail_at_iter = 3
+
+    def train_iter(self, count, recorder):
+        if self.shard_rank == 1 and count >= self.fail_at_iter:
+            raise RuntimeError("injected worker fault")
+        return super().train_iter(count, recorder)

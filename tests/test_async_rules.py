@@ -230,3 +230,26 @@ def test_asgd_resume_fast(tmp_path):
                resume=True)
     res2 = rule2.wait()
     assert np.isfinite(res2["val"]["loss"])
+
+
+@pytest.mark.slow
+def test_worker_fault_aborts_session_fast(tmp_path):
+    """Failure detection (SURVEY §5.3): one worker raising mid-epoch
+    must abort the WHOLE session promptly — the other workers stop at
+    the abort event rather than training out their 50 epochs — and the
+    original exception surfaces from wait()."""
+    import time
+
+    from theanompi_tpu import GOSGD
+
+    rule = GOSGD()
+    t0 = time.monotonic()
+    rule.init(devices=4, modelfile="tests._tiny_models",
+              modelclass="FaultyTinyCifar",
+              config=tiny_cfg(tmp_path, n_epochs=50), p_push=0.3,
+              checkpoint=False)
+    with pytest.raises(RuntimeError, match="injected worker fault"):
+        rule.wait()
+    # 50 epochs x 4 workers takes minutes; fail-fast means the session
+    # dies within the first epoch's compile + a few iterations
+    assert time.monotonic() - t0 < 120
