@@ -34,7 +34,13 @@ BATCH_PER_CHIP = int(os.environ.get("THEANOMPI_TPU_BENCH_BATCH", "128"))
 N_STEPS = int(os.environ.get("THEANOMPI_TPU_BENCH_STEPS", "30"))
 # scanned multi-step cadence (ModelConfig.steps_per_call): k>1 runs k
 # training iterations per device dispatch — bit-identical trajectory,
-# amortizes the per-dispatch overhead that dominates on the tunnel
+# amortizes the per-dispatch overhead that dominates on the tunnel.
+# Default stays 1 until the queued on-chip ladder (k in {1,4,8} x
+# batch {128,256} x stem) validates k>1 on REAL silicon: a round-3
+# CPU probe found the scanned ResNet body 13x slower per step than
+# the unscanned one on the CPU backend (a backend de-optimization,
+# not a trajectory change) — proof that adopting k>1 without an
+# on-chip measurement gambles the round's one official number.
 STEPS_PER_CALL = int(os.environ.get("THEANOMPI_TPU_BENCH_K", "1"))
 if STEPS_PER_CALL < 1:
     raise SystemExit(f"THEANOMPI_TPU_BENCH_K must be >= 1, "
