@@ -42,9 +42,19 @@ from jax.experimental.pallas import tpu as pltpu
 # exactly 0 once any real score is seen, wiping masked contributions.
 # The single source — parallel/sequence.py imports it.
 _MASK_NEG = -1e30
-#: per-(batch*head) VMEM budget for K + V + one fp32 score block
-_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
-_Q_BLOCK = 256
+#: per-(batch*head) VMEM budget for K + V + one fp32 score block.
+#: Env-tunable (THEANOMPI_TPU_ATTN_VMEM_MB / _ATTN_QBLOCK) so on-chip
+#: block-size sweeps need no code edits; defaults are the round-2
+#: interpret-validated values.
+_VMEM_BUDGET_BYTES = int(float(os.environ.get(
+    "THEANOMPI_TPU_ATTN_VMEM_MB", "12")) * 1024 * 1024)
+if _VMEM_BUDGET_BYTES <= 0:
+    raise ValueError("THEANOMPI_TPU_ATTN_VMEM_MB must be positive — 0 "
+                     "would silently route every shape to the XLA path")
+_Q_BLOCK = int(os.environ.get("THEANOMPI_TPU_ATTN_QBLOCK", "256"))
+if _Q_BLOCK < 8 or _Q_BLOCK % 8:
+    raise ValueError(f"THEANOMPI_TPU_ATTN_QBLOCK must be a positive "
+                     f"multiple of 8 (sublane tiling), got {_Q_BLOCK}")
 
 
 def block_scores(q, k, scale):
