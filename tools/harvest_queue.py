@@ -1,0 +1,100 @@
+"""Turn the on-chip experiment queue's JSONL into a decision table.
+
+The TPU tunnel in this environment serves in rare windows, so all
+on-chip experiments run from a sequential queue that appends one JSON
+line per result (BASELINE.md "Round-2 on-chip caveat" explains the
+wedge cycle).  This tool ingests that log and prints:
+
+* a markdown table of every ResNet ladder point (k x batch x stem)
+  with img/s/chip and achieved TF/s (2xMAC, 24.6 GF/img trained),
+* the winning configuration and the env defaults to adopt in bench.py
+  (``THEANOMPI_TPU_BENCH_K`` / ``_BATCH`` and ``resnet_stem``),
+* any attention / h2d / conv-ladder summary lines found.
+
+Usage:
+    python tools/harvest_queue.py /tmp/tpu_queue.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+TRAIN_GF_PER_IMG = 24.6  # 2xMAC, tools/conv_ladder.py
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log", help="queue JSONL (one result object per line)")
+    args = ap.parse_args()
+
+    rows, attn, h2d, ladder, failed, misc = [], [], [], [], [], []
+    with open(args.log) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            exp = rec.get("exp")
+            # failure records carry the exp NAME plus error/tb — they
+            # must land in the failed section, never in a success table
+            if "error" in rec or "tb" in rec:
+                failed.append(rec)
+            elif exp == "resnet50" and "img_per_sec_per_chip" in rec:
+                rows.append(rec)
+            elif exp == "attention":
+                attn.append(rec)
+            elif exp == "h2d":
+                h2d.append(rec)
+            elif rec.get("event") == "ladder_summary" or exp == "conv_ladder":
+                ladder.append(rec)
+            else:
+                misc.append(rec)  # start/done/profile/per-shape rows —
+                # shown verbatim so nothing the queue did goes unreported
+
+    if not rows:
+        print("no ResNet ladder points in the log (tunnel never served?)",
+              file=sys.stderr)
+
+    if rows:
+        print("| k | batch/chip | stem | img/s/chip | TF/s (2xMAC) "
+              "| dispatch ms | compile s |")
+        print("|---|---|---|---|---|---|---|")
+        for r in rows:
+            tfs = r["img_per_sec_per_chip"] * TRAIN_GF_PER_IMG / 1e3
+            print(f"| {r['steps_per_call']} | {r['batch_per_chip']} "
+                  f"| {r.get('stem', 'conv7')} "
+                  f"| {r['img_per_sec_per_chip']} | {tfs:.1f} "
+                  f"| {r['dispatch_ms']} | {r.get('compile_s', '?')} |")
+        best = max(rows, key=lambda r: r["img_per_sec_per_chip"])
+        print(f"\nwinner: k={best['steps_per_call']} "
+              f"b={best['batch_per_chip']} stem={best.get('stem', 'conv7')} "
+              f"-> {best['img_per_sec_per_chip']} img/s/chip")
+        print("adopt in bench.py defaults: "
+              f"THEANOMPI_TPU_BENCH_K={best['steps_per_call']} "
+              f"THEANOMPI_TPU_BENCH_BATCH={best['batch_per_chip']}"
+              + ("" if best.get("stem", "conv7") == "conv7"
+                 else "  (+ ModelConfig resnet_stem='s2d')"))
+
+    for name, items in (("attention", attn), ("h2d", h2d),
+                        ("conv ladder", ladder),
+                        ("other records (start/done/profile/...)", misc)):
+        if items:
+            print(f"\n-- {name} --")
+            for r in items:
+                print(json.dumps(r))
+    if failed:
+        print(f"\n-- {len(failed)} failed experiment(s) --")
+        for r in failed:
+            print(json.dumps(r)[:300])
+    # nonzero when there is nothing to adopt defaults from, so an
+    # automated harvest-then-adopt flow can detect a never-served tunnel
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
