@@ -19,9 +19,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
-TRAIN_GF_PER_IMG = 24.6  # 2xMAC, tools/conv_ladder.py
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from perf_probe import TRAIN_GFLOP_PER_IMAGE as TRAIN_GF_PER_IMG  # noqa: E402
 
 
 def main() -> int:
