@@ -46,11 +46,15 @@ def test_harvest_queue_smoke(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "THEANOMPI_TPU_BENCH_K=1" in r.stdout
     assert "1 failed experiment(s)" in r.stdout
-    # an empty log exits nonzero so automated harvests notice
+    # an empty log exits nonzero so automated harvests notice — assert
+    # the intended message too: a crash also exits 1, and this smoke
+    # must not report an unhandled exception as the designed exit path
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
-    assert _run_tool([os.path.join(REPO_ROOT, "tools/harvest_queue.py"),
-                      str(empty)]).returncode == 1
+    r = _run_tool([os.path.join(REPO_ROOT, "tools/harvest_queue.py"),
+                   str(empty)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "no ResNet ladder points" in r.stderr
 
 
 @pytest.mark.slow

@@ -1,0 +1,12 @@
+"""FLOP-accounting constants shared by the perf tools — import-free,
+so log parsers (harvest_queue) never drag jax/the axon plugin in.
+
+ResNet-50 training cost in 2xMAC FLOPs (the convention of the nominal
+197 TF/s and tools/dispatch_probe.py's measured 2·n³ rates): forward =
+4.09 GMAC = 8.2 GF @ 224x224, x ~3 for fwd+bwd.  The shape-by-shape
+derivation lives in tools/conv_ladder.py and is pinned by
+tests/test_conv_ladder.py.
+"""
+
+TRAIN_GFLOP_PER_IMAGE = 24.6
+V5E_PEAK_TFLOPS = 197.0  # bf16, 2xMAC convention

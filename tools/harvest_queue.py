@@ -24,7 +24,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from perf_probe import TRAIN_GFLOP_PER_IMAGE as TRAIN_GF_PER_IMG  # noqa: E402
+from flop_constants import TRAIN_GFLOP_PER_IMAGE as TRAIN_GF_PER_IMG  # noqa: E402
 
 
 def main() -> int:

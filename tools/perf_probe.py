@@ -25,11 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# ResNet-50 training cost in 2xMAC FLOPs (the convention of the
-# nominal 197 TF/s and tools/dispatch_probe.py's measured rates):
-# forward = 4.09 GMAC = 8.2 GF @ 224x224, x ~3 for fwd+bwd.
-TRAIN_GFLOP_PER_IMAGE = 24.6
-V5E_PEAK_TFLOPS = 197.0  # bf16
+from flop_constants import TRAIN_GFLOP_PER_IMAGE, V5E_PEAK_TFLOPS  # noqa: E402
 
 
 def time_step(step, state, batch, rng, n_steps: int, warmup: int = 3):

@@ -1,0 +1,122 @@
+"""One ResNet-50 ladder point for the on-chip experiment queue.
+
+Times the jitted BSP train step at a single (steps_per_call, batch,
+stem) coordinate and prints ONE JSON line in the schema
+``tools/harvest_queue.py`` ingests (``exp=resnet50``).  Run by
+``tools/run_tpu_queue.py`` as a subprocess so a wedged tunnel kills
+only this point, not the queue.
+
+Usage:
+    python tools/queue_resnet_point.py --k 4 --batch 256 --stem s2d
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))  # repo root: theanompi_tpu
+sys.path.insert(0, _TOOLS)                   # _bootstrap
+
+import _bootstrap  # noqa: F401,E402  (makes JAX_PLATFORMS effective)
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def fenced_loss(metrics) -> float:
+    """Value readback — the only fence the axon tunnel honors.
+    Multi-step metrics come back stacked (k,); fence on the last."""
+    return float(np.asarray(metrics["loss"]).ravel()[-1])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=1, help="steps_per_call")
+    ap.add_argument("--batch", type=int, default=128, help="per-chip")
+    ap.add_argument("--stem", default="conv7", choices=("conv7", "s2d"))
+    ap.add_argument("--steps", type=int, default=32,
+                    help="timed training iterations (k-dispatch rounded)")
+    ap.add_argument("--crop", type=int, default=224,
+                    help="input crop; shrink for off-chip wiring checks "
+                    "(ResNet-50 is fully convolutional + global pool)")
+    args = ap.parse_args()
+    store = max(256, args.crop + 32) if args.crop >= 224 \
+        else args.crop + args.crop // 4
+
+    from theanompi_tpu.models.base import (ModelConfig,
+                                           _stack_host_batches)
+    from theanompi_tpu.models.resnet50 import ResNet50
+    from theanompi_tpu.data.imagenet import ImageNet_data
+    from theanompi_tpu.parallel.mesh import data_mesh, shard_batch
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = data_mesh(n_chips, devices)
+    global_batch = args.batch * n_chips
+
+    class PointResNet50(ResNet50):
+        def build_data(self):
+            return ImageNet_data(crop=args.crop,
+                                 synthetic_n=global_batch * args.k,
+                                 synthetic_pool=8, synthetic_store=store,
+                                 augment_on_device=True)
+
+    cfg = ModelConfig(batch_size=args.batch, compute_dtype="bfloat16",
+                      steps_per_call=args.k, resnet_stem=args.stem,
+                      track_top5=False, print_freq=10**9)
+    model = PointResNet50(config=cfg, mesh=mesh, verbose=False)
+    model.compile_iter_fns("avg")
+
+    host_it = model.data.train_batches(0, global_batch)
+    if args.k > 1:
+        stacked = _stack_host_batches(host_it, args.k)
+        staged = shard_batch(next(stacked), mesh,
+                             spec=model.stacked_batch_spec())
+        step_fn = model.train_step_multi
+    else:
+        staged = shard_batch(next(host_it), mesh)
+        step_fn = model.train_step
+
+    rng = jax.random.key(0)
+    state = model.state
+    t0 = time.perf_counter()
+    state, metrics = step_fn(state, staged, rng)
+    fenced_loss(metrics)
+    compile_s = time.perf_counter() - t0
+    for _ in range(2):  # settle to steady state
+        state, metrics = step_fn(state, staged, rng)
+    fenced_loss(metrics)
+
+    n_disp = max(1, args.steps // args.k)
+    t0 = time.perf_counter()
+    for _ in range(n_disp):
+        state, metrics = step_fn(state, staged, rng)
+    loss = fenced_loss(metrics)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    per_chip = n_disp * args.k * global_batch / dt / n_chips
+    print(json.dumps({
+        # a shrunken-crop wiring check must never enter the ladder
+        # table harvest_queue builds from exp=="resnet50" rows
+        "exp": "resnet50" if args.crop == 224 else "resnet50_wiring",
+        "crop": args.crop,
+        "steps_per_call": args.k,
+        "batch_per_chip": args.batch,
+        "stem": args.stem,
+        "img_per_sec_per_chip": round(per_chip, 2),
+        "step_ms": round(dt / (n_disp * args.k) * 1e3, 2),
+        "dispatch_ms": round(dt / n_disp * 1e3, 2),
+        "compile_s": round(compile_s, 1),
+        "loss": round(loss, 4),
+        "backend": jax.default_backend(),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,167 @@
+"""Sequential on-chip experiment queue (VERDICT r2 next-round #1).
+
+The axon TPU tunnel serves in rare windows (BASELINE.md "Round-2
+on-chip caveat"), so every on-chip experiment runs from this one
+queue: each experiment is a SUBPROCESS with its own timeout, and every
+result — success or failure — is appended to the queue JSONL the
+moment it lands, so a mid-run wedge loses only the in-flight point.
+``tools/harvest_queue.py`` turns the log into the decision table and
+tuned bench defaults.
+
+Priority order front-loads the decisions the round needs: the k-ladder
+(does multi-step scan amortize dispatch on real silicon?), then batch,
+then stem, then the per-op MFU ladder, attention microbench, and the
+3-epoch CIFAR smoke train with snapshots in artifacts/tpu_smoke.
+
+Usage:
+    python tools/run_tpu_queue.py --out /tmp/tpu_queue.jsonl
+    python tools/run_tpu_queue.py --only resnet  # just the ladder
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+PY = sys.executable
+MAX_ATTEMPTS = int(os.environ.get("THEANOMPI_TPU_QUEUE_ATTEMPTS", "3"))
+
+
+def experiments(smoke_dir: str):
+    """(name, argv, timeout_s) in priority order."""
+    pt = os.path.join(TOOLS, "queue_resnet_point.py")
+    # Timeouts are sized to survive a FULL tunnel wedge cycle (~25 min,
+    # BASELINE.md): a wedged client recovers on its own and the
+    # experiment then proceeds, whereas killing it early re-wedges the
+    # pool lease (the round-2 lesson encoded in bench.py's probe).
+    # Healthy runtimes are 2-4 min per point.
+    exps = []
+    # 1. k-ladder at the round-2 default batch: the dispatch-floor
+    # question.  k=1 first revalidates the baseline in this window.
+    for k in (1, 4, 8):
+        exps.append((f"resnet_k{k}_b128_conv7",
+                     [PY, pt, "--k", str(k), "--batch", "128"], 2100))
+    # 2. batch ladder at each k (compile per point; b=256 halves the
+    # dispatch count per image even at k=1)
+    for k in (1, 4, 8):
+        exps.append((f"resnet_k{k}_b256_conv7",
+                     [PY, pt, "--k", str(k), "--batch", "256"], 2100))
+    # 3. the s2d stem (MXU-friendly 4x4 stem) at the two extremes
+    exps.append(("resnet_k1_b128_s2d",
+                 [PY, pt, "--k", "1", "--batch", "128", "--stem", "s2d"],
+                 2100))
+    exps.append(("resnet_k8_b256_s2d",
+                 [PY, pt, "--k", "8", "--batch", "256", "--stem", "s2d"],
+                 2100))
+    # 4. per-op MFU account (VERDICT r2 #2): every distinct conv shape
+    # timed fwd and fwd+bwd, reconciled against the full step
+    exps.append(("conv_ladder_b128",
+                 [PY, os.path.join(TOOLS, "conv_ladder.py"),
+                  "--batch", "128"], 3600))
+    # 5. attention microbench: validates the Pallas 'auto' default on
+    # real silicon (ADVICE r2: ragged fwd only ever ran in interpret)
+    exps.append(("attention_b8_t1024",
+                 [PY, os.path.join(TOOLS, "bench_attention.py"),
+                  "8", "1024"], 2100))
+    # 6. 3-epoch CIFAR smoke through the full rule/recorder/checkpoint
+    # spine, snapshots into the repo as the round's on-chip artifact
+    exps.append(("cifar10_smoke",
+                 [PY, "-m", "theanompi_tpu.launcher", "BSP",
+                  "-m", "cifar10", "--epochs", "3",
+                  "--snapshot-dir", smoke_dir,
+                  "--result-json", os.path.join(smoke_dir, "result.json")],
+                 3600))
+    return exps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/tpu_queue.jsonl")
+    ap.add_argument("--only", default=None,
+                    help="substring filter on experiment names")
+    ap.add_argument("--smoke-dir",
+                    default=os.path.join(REPO, "artifacts", "tpu_smoke"))
+    args = ap.parse_args()
+
+    sink = open(args.out, "a", buffering=1)
+
+    def emit(obj):
+        line = json.dumps(obj)
+        sink.write(line + "\n")
+        print(line, flush=True)
+
+    env = dict(os.environ)
+    # Keep JAX_PLATFORMS / PYTHONPATH exactly as the image sets them
+    # (JAX_PLATFORMS=axon + PYTHONPATH=/root/.axon_site): clearing the
+    # platform pin sends the plugin through autodiscovery, which wedges
+    # device init on this tunnel — but refuse a CPU override outright,
+    # since the queue exists to measure the chip.
+    if env.get("JAX_PLATFORMS") not in (None, "", "axon", "tpu"):
+        raise SystemExit(f"JAX_PLATFORMS={env['JAX_PLATFORMS']!r} would "
+                         "run the on-chip queue off-chip; unset it")
+    env.setdefault("THEANOMPI_TPU_SERVICE_KEY", "queue-local")
+
+    todo = [(name, argv, timeout, 1)
+            for name, argv, timeout in experiments(args.smoke_dir)
+            if not args.only or args.only in name]
+    emit({"event": "queue_start", "n_experiments": len(todo),
+          "ts": time.time()})
+    os.makedirs(args.smoke_dir, exist_ok=True)
+
+    while todo:
+        name, argv, timeout, attempt = todo.pop(0)
+        t0 = time.time()
+        emit({"event": "start", "name": name, "attempt": attempt})
+        try:
+            r = subprocess.run(argv, capture_output=True, text=True,
+                               timeout=timeout, env=env, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            r = None
+        wall = round(time.time() - t0, 1)
+        if r is None or r.returncode != 0:
+            err = (f"timeout after {timeout}s (wedged tunnel?)" if r is None
+                   else f"rc={r.returncode}")
+            rec = {"exp": name, "error": err, "attempt": attempt,
+                   "wall_s": wall}
+            if r is not None:
+                rec["tb"] = "; ".join(r.stderr.strip().splitlines()[-4:])
+            # a wedge window can swallow several points in a row, so a
+            # failed point goes to the BACK of the queue for up to
+            # MAX_ATTEMPTS total tries — later is better than sooner
+            # when the failure mode recovers on its own
+            if attempt < MAX_ATTEMPTS:
+                rec["requeued"] = True
+                todo.append((name, argv, timeout, attempt + 1))
+            emit(rec)
+            continue
+        # forward every JSON line the experiment printed; non-JSON
+        # stdout (bench_attention prints a table) is wrapped verbatim
+        got = False
+        for line in r.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                rec = {"exp": name, "text": line}
+            else:
+                rec.setdefault("exp", name)
+            emit(rec)
+            got = True
+        emit({"event": "done", "name": name, "wall_s": wall,
+              "produced_output": got})
+
+    emit({"event": "queue_done", "ts": time.time()})
+    sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
