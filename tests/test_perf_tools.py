@@ -83,3 +83,54 @@ def test_conv_ladder_smoke():
     assert summary["event"] == "ladder_summary"
     # canonical ResNet-50: 8.18 GF/img fwd in 2xMAC units
     assert abs(summary["sum_gflops_fwd"] - 8.18) < 0.2
+
+
+def test_run_tpu_queue_requeue_and_forwarding(tmp_path):
+    """Drive the queue runner's real machinery (subprocess per
+    experiment, timeout kill, requeue-to-back, JSON/stdout forwarding)
+    with stub commands via --exps-json; the built-in on-chip ladder
+    itself can only run against the tunnel."""
+    ok = ("import json; print(json.dumps({'img_per_sec_per_chip': 1.0}));"
+          "print('plain text line')")
+    exps = [
+        ["stub_ok", [sys.executable, "-c", ok], 60],
+        ["stub_fail", [sys.executable, "-c", "raise SystemExit(3)"], 60],
+        ["stub_hang", [sys.executable, "-c",
+                       "import time; time.sleep(120)"], 2],
+    ]
+    exps_file = tmp_path / "exps.json"
+    exps_file.write_text(json.dumps(exps))
+    out = tmp_path / "queue.jsonl"
+    r = _run_tool([os.path.join(REPO_ROOT, "tools/run_tpu_queue.py"),
+                   "--out", str(out), "--exps-json", str(exps_file),
+                   "--smoke-dir", str(tmp_path / "smoke")],
+                  timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    recs = [json.loads(line) for line in out.read_text().splitlines()]
+
+    # success: its JSON line is forwarded with exp defaulted to the
+    # experiment name; non-JSON stdout is wrapped, not dropped
+    fwd = [x for x in recs if x.get("exp") == "stub_ok"]
+    assert any(x.get("img_per_sec_per_chip") == 1.0 for x in fwd)
+    assert any(x.get("text") == "plain text line" for x in fwd)
+
+    # failure and hang: recorded with the error, requeued to the BACK
+    # up to 3 attempts, never marked done
+    for name, err_frag in (("stub_fail", "rc=3"), ("stub_hang", "timeout")):
+        fails = [x for x in recs if x.get("exp") == name and "error" in x]
+        assert len(fails) == 3, (name, fails)
+        assert all(err_frag in x["error"] for x in fails)
+        assert [x["attempt"] for x in fails] == [1, 2, 3]
+        assert all(x.get("requeued") for x in fails[:2])
+        assert not fails[2].get("requeued")
+    # attempt-2 records come after every attempt-1 record (requeue goes
+    # to the back of the queue, preserving ladder priority order)
+    idx = {(x.get("exp"), x.get("attempt")): i for i, x in enumerate(recs)
+           if "error" in x}
+    assert idx[("stub_fail", 2)] > idx[("stub_hang", 1)]
+
+    starts = [x for x in recs if x.get("event") == "start"]
+    dones = [x for x in recs if x.get("event") == "done"]
+    assert len(starts) == 7  # 3 + 2 requeues each for fail and hang
+    assert [d["name"] for d in dones] == ["stub_ok"]
+    assert recs[-1]["event"] == "queue_done"

@@ -87,6 +87,11 @@ def main() -> int:
                     help="substring filter on experiment names")
     ap.add_argument("--smoke-dir",
                     default=os.path.join(REPO, "artifacts", "tpu_smoke"))
+    ap.add_argument("--exps-json", default=None,
+                    help="JSON file with [[name, argv, timeout_s], ...] "
+                    "overriding the built-in ladder — lets tests drive "
+                    "the timeout/requeue/forwarding machinery with stub "
+                    "commands, and operators replay a subset")
     args = ap.parse_args()
 
     sink = open(args.out, "a", buffering=1)
@@ -101,14 +106,21 @@ def main() -> int:
     # (JAX_PLATFORMS=axon + PYTHONPATH=/root/.axon_site): clearing the
     # platform pin sends the plugin through autodiscovery, which wedges
     # device init on this tunnel — but refuse a CPU override outright,
-    # since the queue exists to measure the chip.
-    if env.get("JAX_PLATFORMS") not in (None, "", "axon", "tpu"):
+    # since the built-in queue exists to measure the chip.  Injected
+    # --exps-json experiments carry their own platform choices (that is
+    # how tests drive this machinery off-chip).
+    if (not args.exps_json
+            and env.get("JAX_PLATFORMS") not in (None, "", "axon", "tpu")):
         raise SystemExit(f"JAX_PLATFORMS={env['JAX_PLATFORMS']!r} would "
                          "run the on-chip queue off-chip; unset it")
     env.setdefault("THEANOMPI_TPU_SERVICE_KEY", "queue-local")
 
-    todo = [(name, argv, timeout, 1)
-            for name, argv, timeout in experiments(args.smoke_dir)
+    if args.exps_json:
+        with open(args.exps_json) as fh:
+            exps = [tuple(e) for e in json.load(fh)]
+    else:
+        exps = experiments(args.smoke_dir)
+    todo = [(name, argv, timeout, 1) for name, argv, timeout in exps
             if not args.only or args.only in name]
     emit({"event": "queue_start", "n_experiments": len(todo),
           "ts": time.time()})
