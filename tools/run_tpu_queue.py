@@ -33,32 +33,68 @@ PY = sys.executable
 MAX_ATTEMPTS = int(os.environ.get("THEANOMPI_TPU_QUEUE_ATTEMPTS", "3"))
 
 
+PROBE_CODE = "import jax; print(jax.devices()[0].platform)"
+
+
+def wait_for_tunnel(emit, env, poll_timeout: int, poll_interval: int):
+    """Block until a fresh client can initialize the backend.
+
+    A client that STARTS during a wedge fails UNAVAILABLE ~25 min
+    later even if the tunnel recovers meanwhile (round-2/3 pattern:
+    wall_s 1503 on every wedged attempt), so long experiment timeouts
+    can sleep through an entire serving window.  Round 2's supervisor
+    retried every ~2 min for 7+ hours and still caught the one window
+    that opened — short-cadence probing neither prevents recovery nor
+    misses windows.  Healthy tunnels answer the probe in ~15-40 s.
+    """
+    t0 = time.time()
+    attempts = 0
+    while True:
+        attempts += 1
+        try:
+            r = subprocess.run([PY, "-c", PROBE_CODE], env=env,
+                               capture_output=True, text=True,
+                               timeout=poll_timeout)
+        except subprocess.TimeoutExpired:
+            r = None
+        if r is not None and r.returncode == 0 and r.stdout.strip():
+            if attempts > 1:
+                emit({"event": "tunnel_up",
+                      "waited_s": round(time.time() - t0, 1),
+                      "probe_attempts": attempts})
+            return
+        if attempts == 1:
+            emit({"event": "tunnel_wait", "ts": time.time()})
+        time.sleep(poll_interval)
+
+
 def experiments(smoke_dir: str):
-    """(name, argv, timeout_s) in priority order."""
+    """(name, argv, timeout_s) in priority order.
+
+    Timeouts are sized for a HEALTHY tunnel plus margin — the gate
+    probe in the main loop ensures experiments only launch when a
+    fresh client just initialized, so a block longer than the timeout
+    means the window closed mid-experiment: reclaim and requeue.
+    Healthy runtimes are 2-4 min per ResNet point."""
     pt = os.path.join(TOOLS, "queue_resnet_point.py")
-    # Timeouts are sized to survive a FULL tunnel wedge cycle (~25 min,
-    # BASELINE.md): a wedged client recovers on its own and the
-    # experiment then proceeds, whereas killing it early re-wedges the
-    # pool lease (the round-2 lesson encoded in bench.py's probe).
-    # Healthy runtimes are 2-4 min per point.
     exps = []
     # 1. k-ladder at the round-2 default batch: the dispatch-floor
     # question.  k=1 first revalidates the baseline in this window.
     for k in (1, 4, 8):
         exps.append((f"resnet_k{k}_b128_conv7",
-                     [PY, pt, "--k", str(k), "--batch", "128"], 2100))
+                     [PY, pt, "--k", str(k), "--batch", "128"], 900))
     # 2. batch ladder at each k (compile per point; b=256 halves the
     # dispatch count per image even at k=1)
     for k in (1, 4, 8):
         exps.append((f"resnet_k{k}_b256_conv7",
-                     [PY, pt, "--k", str(k), "--batch", "256"], 2100))
+                     [PY, pt, "--k", str(k), "--batch", "256"], 900))
     # 3. the s2d stem (MXU-friendly 4x4 stem) at the two extremes
     exps.append(("resnet_k1_b128_s2d",
                  [PY, pt, "--k", "1", "--batch", "128", "--stem", "s2d"],
-                 2100))
+                 900))
     exps.append(("resnet_k8_b256_s2d",
                  [PY, pt, "--k", "8", "--batch", "256", "--stem", "s2d"],
-                 2100))
+                 900))
     # 4. per-op MFU account (VERDICT r2 #2): every distinct conv shape
     # timed fwd and fwd+bwd, reconciled against the full step
     exps.append(("conv_ladder_b128",
@@ -68,7 +104,7 @@ def experiments(smoke_dir: str):
     # real silicon (ADVICE r2: ragged fwd only ever ran in interpret)
     exps.append(("attention_b8_t1024",
                  [PY, os.path.join(TOOLS, "bench_attention.py"),
-                  "8", "1024"], 2100))
+                  "8", "1024"], 1200))
     # 6. 3-epoch CIFAR smoke through the full rule/recorder/checkpoint
     # spine, snapshots into the repo as the round's on-chip artifact
     exps.append(("cifar10_smoke",
@@ -92,6 +128,11 @@ def main() -> int:
                     "overriding the built-in ladder — lets tests drive "
                     "the timeout/requeue/forwarding machinery with stub "
                     "commands, and operators replay a subset")
+    ap.add_argument("--poll-timeout", type=int, default=150,
+                    help="gate-probe client timeout (healthy tunnels "
+                    "answer in ~15-40s; a wedged one just blocks)")
+    ap.add_argument("--poll-interval", type=int, default=90,
+                    help="sleep between gate probes while wedged")
     args = ap.parse_args()
 
     sink = open(args.out, "a", buffering=1)
@@ -128,6 +169,9 @@ def main() -> int:
 
     while todo:
         name, argv, timeout, attempt = todo.pop(0)
+        if not args.exps_json:
+            wait_for_tunnel(emit, env, args.poll_timeout,
+                            args.poll_interval)
         t0 = time.time()
         emit({"event": "start", "name": name, "attempt": attempt})
         try:
