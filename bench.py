@@ -52,6 +52,8 @@ if STEPS_PER_CALL > E2E_STEPS:
 
 
 PROBE_WINDOW_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_S", "1800"))
+PROBE_ATTEMPT_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_ATTEMPT_S",
+                                     "150"))
 
 
 def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
@@ -61,14 +63,17 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
     platform is None if the backend is unusable, with the actual
     failure mode in ``error``.
 
-    Retries inside an env-capped window (``THEANOMPI_TPU_BENCH_PROBE_S``,
-    default 30 min ≈ one wedge cycle): round 2's single 300 s attempt
-    zeroed the round's official record on a transient wedge.  Killing a
-    hung client early can itself re-wedge the pool lease, so each
-    attempt gets the full remaining window — a healthy tunnel answers in
-    seconds, a wedged one fails UNAVAILABLE on its own at ~25 min and
-    the lease often recovers right after, which a follow-up attempt
-    catches."""
+    Retries at a SHORT cadence inside an env-capped window
+    (``THEANOMPI_TPU_BENCH_PROBE_S``, default 30 min): round 2's single
+    300 s attempt zeroed the round's official record on a transient
+    wedge.  Each attempt is capped at ``PROBE_ATTEMPT_S`` (healthy
+    tunnels answer in ~15-40 s) because a client that STARTS during a
+    wedge fails UNAVAILABLE ~25 min later even if the tunnel recovers
+    meanwhile — a single full-window blocked attempt would sleep
+    through a serving window that opens mid-probe.  Round 2's
+    supervisor retried every ~2 min for hours and still caught the one
+    window that opened, so short-cadence kills neither prevent lease
+    recovery nor miss windows."""
     # this image's sitecustomize pre-registers the axon plugin and
     # ignores the env var alone — apply it via jax.config like the
     # test conftest does, so JAX_PLATFORMS=cpu runs bench on CPU
@@ -85,16 +90,19 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
             return None, (f"{last_err} — gave up after {attempts} "
                           f"attempt(s) in a {window_s}s window")
         attempts += 1
-        t_attempt = time.monotonic()  # for honest hang-duration reports
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=remaining)
+                capture_output=True, text=True,
+                timeout=min(PROBE_ATTEMPT_S, remaining))
         except subprocess.TimeoutExpired:
-            hung_s = time.monotonic() - t_attempt
-            return None, (f"device init attempt {attempts} still hung "
-                          f"after {hung_s:.0f}s at the end of the "
-                          f"{window_s}s probe window (wedged tunnel?)")
+            # blocked in device init = wedged RIGHT NOW; a fresh client
+            # after the wedge clears is the only thing that ever
+            # succeeds, so kill, wait, re-probe until the window ends
+            last_err = (f"device init hung past {PROBE_ATTEMPT_S}s "
+                        "(wedged tunnel?)")
+            time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
+            continue
         out = r.stdout.strip().splitlines()
         if r.returncode == 0 and out:
             return out[-1], ""

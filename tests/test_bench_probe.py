@@ -85,14 +85,49 @@ def test_probe_gives_up_when_window_exhausted(monkeypatch):
     assert "UNAVAILABLE" in err and "attempt" in err
 
 
-def test_probe_hang_is_terminal(monkeypatch):
-    """A probe that never answers (killed at window end) must not loop:
-    the kill itself can re-wedge the lease, so one hang ends the probe."""
+def test_probe_hang_retries_at_short_cadence(monkeypatch):
+    """A blocked device init means wedged RIGHT NOW — and a client that
+    starts during a wedge fails ~25 min later even if the tunnel
+    recovers meanwhile, so the probe must kill at short cadence and
+    re-probe (a fresh client is the only thing that ever succeeds)
+    instead of letting one blocked attempt eat the whole window."""
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__(0, clock[0] + s))
+    timeouts = []
 
     def fake_run(*a, timeout=None, **k):
+        timeouts.append(timeout)
+        clock[0] += timeout  # the kill fires at the attempt cap
         raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
 
     monkeypatch.setattr(bench.subprocess, "run", fake_run)
-    platform, err = bench._probe_backend(window_s=60)
+    platform, err = bench._probe_backend(window_s=700)
     assert platform is None
-    assert "wedged tunnel" in err
+    assert "hung past" in err and "wedged tunnel" in err
+    assert len(timeouts) >= 3  # kept re-probing, not one terminal hang
+    assert all(t <= bench.PROBE_ATTEMPT_S for t in timeouts)
+
+
+def test_probe_hang_then_recovery_is_caught(monkeypatch):
+    """The reason for the short cadence: a window that opens mid-probe
+    must be caught by a later fresh client."""
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__(0, clock[0] + s))
+    calls = []
+
+    def fake_run(*a, timeout=None, **k):
+        calls.append(timeout)
+        if len(calls) < 3:
+            clock[0] += timeout
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+        clock[0] += 20.0
+        return _Result(0, "axon\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    platform, err = bench._probe_backend(window_s=1800)
+    assert platform == "axon" and err == ""
+    assert len(calls) == 3
