@@ -56,6 +56,36 @@ PROBE_ATTEMPT_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_ATTEMPT_S",
                                      "150"))
 
 
+def _run_probe_sub(argv, timeout):
+    """Run the probe with FILE-backed stdio and a process-group kill.
+
+    ``subprocess.run(capture_output=True, timeout=...)`` deadlocks on
+    this tunnel: the axon client spawns helper grandchildren that
+    inherit the stdout pipe, so after the timeout kill the internal
+    ``communicate()`` blocks forever on a pipe the orphans hold open
+    (observed live in round 3: a 150 s probe still "running" at 9 min).
+    Returns (rc, stdout, stderr, timed_out)."""
+    import signal
+    import tempfile
+
+    with tempfile.TemporaryFile() as fo, tempfile.TemporaryFile() as fe:
+        p = subprocess.Popen(argv, stdout=fo, stderr=fe,
+                             start_new_session=True)
+        try:
+            rc, timed_out = p.wait(timeout=timeout), False
+        except subprocess.TimeoutExpired:
+            rc, timed_out = None, True
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            p.wait()
+        fo.seek(0)
+        fe.seek(0)
+        return (rc, fo.read().decode(errors="replace"),
+                fe.read().decode(errors="replace"), timed_out)
+
+
 def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
     """Initialize the backend in a SUBPROCESS first: a wedged axon
     tunnel hangs ``jax.devices()`` for ~25 min before failing, which
@@ -90,12 +120,10 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
             return None, (f"{last_err} — gave up after {attempts} "
                           f"attempt(s) in a {window_s}s window")
         attempts += 1
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True,
-                timeout=min(PROBE_ATTEMPT_S, remaining))
-        except subprocess.TimeoutExpired:
+        rc, stdout, stderr, timed_out = _run_probe_sub(
+            [sys.executable, "-c", code],
+            timeout=min(PROBE_ATTEMPT_S, remaining))
+        if timed_out:
             # blocked in device init = wedged RIGHT NOW; a fresh client
             # after the wedge clears is the only thing that ever
             # succeeds, so kill, wait, re-probe until the window ends
@@ -103,11 +131,11 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
                         "(wedged tunnel?)")
             time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
             continue
-        out = r.stdout.strip().splitlines()
-        if r.returncode == 0 and out:
+        out = stdout.strip().splitlines()
+        if rc == 0 and out:
             return out[-1], ""
-        tail = "; ".join(r.stderr.strip().splitlines()[-3:])
-        err = f"backend init failed (rc={r.returncode}): {tail}"
+        tail = "; ".join(stderr.strip().splitlines()[-3:])
+        err = f"backend init failed (rc={rc}): {tail}"
         # bail ONLY on signatures that are deterministic by
         # construction (the misconfigs actually hit in round 2: a
         # platform name jax doesn't know, or PYTHONPATH clobbering the

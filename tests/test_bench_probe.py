@@ -1,28 +1,41 @@
 """bench.py backend-probe retry (VERDICT r2 weak #1).
 
 Round 2's official record was zeroed by a single 300 s probe attempt
-hitting a transient tunnel wedge.  The probe now retries fast failures
-inside an env-capped window and only gives up when the window is
-exhausted; these tests drive that loop with a mocked subprocess so the
-policy is covered without a tunnel (the real-backend path is exercised
-by the driver's bench run).
+hitting a transient tunnel wedge.  The probe now retries at SHORT
+cadence (a client that starts during a wedge fails ~25 min later even
+if the tunnel recovers meanwhile, so one long blocked attempt would
+sleep through a serving window) inside an env-capped window; these
+tests drive that loop with a mocked probe runner so the policy is
+covered without a tunnel (the real-backend path is exercised by the
+driver's bench run).  The probe runner itself is file-backed +
+process-group-killed because ``subprocess.run(capture_output=True)``
+deadlocks on axon helper grandchildren holding the stdout pipe; its
+real-subprocess behavior is covered by
+tests/test_perf_tools.py::test_run_tpu_queue_requeue_and_forwarding
+driving the queue runner's identical helper.
 """
 
 import subprocess
+import sys
 
 import pytest
 
 import bench
 
 
-class _Result:
-    def __init__(self, rc, out="", err=""):
-        self.returncode, self.stdout, self.stderr = rc, out, err
+def _ok(platform="axon"):
+    return (0, platform + "\n", "", False)
+
+
+def _fail(stderr):
+    return (1, "", stderr, False)
+
+
+_HANG = (None, "", "", True)
 
 
 def test_probe_success_first_try(monkeypatch):
-    monkeypatch.setattr(bench.subprocess, "run",
-                        lambda *a, **k: _Result(0, "axon\n"))
+    monkeypatch.setattr(bench, "_run_probe_sub", lambda *a, **k: _ok())
     platform, err = bench._probe_backend(window_s=60)
     assert platform == "axon" and err == ""
 
@@ -30,19 +43,20 @@ def test_probe_success_first_try(monkeypatch):
 def test_probe_retries_past_fast_failures(monkeypatch):
     calls = []
 
-    def fake_run(*a, timeout=None, **k):
+    def fake(argv, timeout):
         calls.append(timeout)
         if len(calls) < 3:
-            return _Result(1, "", "UNAVAILABLE: lease wedged\n")
-        return _Result(0, "axon\n")
+            return _fail("UNAVAILABLE: lease wedged\n")
+        return _ok()
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_probe_sub", fake)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     platform, err = bench._probe_backend(window_s=3600)
     assert platform == "axon" and err == ""
     assert len(calls) == 3
-    # every attempt must be bounded by the remaining window, not ∞
-    assert all(t is not None and t <= 3600 for t in calls)
+    # every attempt is capped at the short cadence, not the window
+    assert all(t is not None and t <= bench.PROBE_ATTEMPT_S
+               for t in calls)
 
 
 @pytest.mark.parametrize("stderr", [
@@ -57,11 +71,11 @@ def test_probe_bails_on_deterministic_signatures(monkeypatch, stderr):
     bursts — keeps retrying (see the retry tests)."""
     calls = []
 
-    def fake_run(*a, timeout=None, **k):
+    def fake(argv, timeout):
         calls.append(1)
-        return _Result(1, "", stderr)
+        return _fail(stderr)
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_probe_sub", fake)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     platform, err = bench._probe_backend(window_s=3600)
     assert platform is None
@@ -75,34 +89,33 @@ def test_probe_gives_up_when_window_exhausted(monkeypatch):
     monkeypatch.setattr(bench.time, "sleep",
                         lambda s: clock.__setitem__(0, clock[0] + s))
 
-    def fake_run(*a, timeout=None, **k):
+    def fake(argv, timeout):
         clock[0] += 20.0  # each failed attempt burns 20 s
-        return _Result(1, "", "UNAVAILABLE: pool lease\n")
+        return _fail("UNAVAILABLE: pool lease\n")
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_probe_sub", fake)
     platform, err = bench._probe_backend(window_s=100)
     assert platform is None
     assert "UNAVAILABLE" in err and "attempt" in err
 
 
 def test_probe_hang_retries_at_short_cadence(monkeypatch):
-    """A blocked device init means wedged RIGHT NOW — and a client that
-    starts during a wedge fails ~25 min later even if the tunnel
-    recovers meanwhile, so the probe must kill at short cadence and
-    re-probe (a fresh client is the only thing that ever succeeds)
-    instead of letting one blocked attempt eat the whole window."""
+    """A blocked device init means wedged RIGHT NOW — kill at the
+    attempt cap and re-probe with a fresh client (the only thing that
+    ever succeeds) instead of letting one blocked attempt eat the
+    whole window."""
     clock = [0.0]
     monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
     monkeypatch.setattr(bench.time, "sleep",
                         lambda s: clock.__setitem__(0, clock[0] + s))
     timeouts = []
 
-    def fake_run(*a, timeout=None, **k):
+    def fake(argv, timeout):
         timeouts.append(timeout)
         clock[0] += timeout  # the kill fires at the attempt cap
-        raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+        return _HANG
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_probe_sub", fake)
     platform, err = bench._probe_backend(window_s=700)
     assert platform is None
     assert "hung past" in err and "wedged tunnel" in err
@@ -119,15 +132,30 @@ def test_probe_hang_then_recovery_is_caught(monkeypatch):
                         lambda s: clock.__setitem__(0, clock[0] + s))
     calls = []
 
-    def fake_run(*a, timeout=None, **k):
+    def fake(argv, timeout):
         calls.append(timeout)
         if len(calls) < 3:
             clock[0] += timeout
-            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+            return _HANG
         clock[0] += 20.0
-        return _Result(0, "axon\n")
+        return _ok()
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_run_probe_sub", fake)
     platform, err = bench._probe_backend(window_s=1800)
     assert platform == "axon" and err == ""
     assert len(calls) == 3
+
+
+def test_run_probe_sub_real_timeout_kills_group():
+    """The file-backed runner must return on timeout even when the
+    child's own child keeps the (nonexistent) pipe alive — the exact
+    deadlock subprocess.run(capture_output=True) hit on axon."""
+    code = ("import subprocess, sys, time\n"
+            "subprocess.Popen([sys.executable, '-c',"
+            " 'import time; time.sleep(60)'])\n"
+            "print('parent up', flush=True)\n"
+            "time.sleep(60)\n")
+    rc, out, err, timed_out = bench._run_probe_sub(
+        [sys.executable, "-c", code], timeout=3)
+    assert timed_out and rc is None
+    assert "parent up" in out  # pre-kill output still readable

@@ -23,9 +23,41 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
+import tempfile
 import time
+
+
+def run_sub(argv, timeout, env, cwd=None):
+    """subprocess.run replacement that survives axon-client children.
+
+    The axon jax client spawns helper grandchildren that inherit
+    stdout/stderr; with ``subprocess.run(capture_output=True,
+    timeout=...)`` the post-kill ``communicate()`` then blocks forever
+    on the pipe the orphans still hold (observed live: a 150 s probe
+    still "running" at 9 min).  File-backed stdio can't hang, and
+    ``killpg`` on the child's fresh session nukes the grandchildren
+    too.  Returns (rc, stdout, stderr, timed_out); rc is None iff
+    timed out."""
+    with tempfile.TemporaryFile() as fo, tempfile.TemporaryFile() as fe:
+        p = subprocess.Popen(argv, stdout=fo, stderr=fe, env=env,
+                             cwd=cwd, start_new_session=True)
+        try:
+            rc, timed_out = p.wait(timeout=timeout), False
+        except subprocess.TimeoutExpired:
+            rc, timed_out = None, True
+            try:
+                os.killpg(p.pid, signal.SIGKILL)  # pgid==pid: new session
+            except ProcessLookupError:
+                pass
+            p.wait()
+        fo.seek(0)
+        fe.seek(0)
+        out = fo.read().decode(errors="replace")
+        err = fe.read().decode(errors="replace")
+    return rc, out, err, timed_out
 
 TOOLS = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(TOOLS)
@@ -51,13 +83,9 @@ def wait_for_tunnel(emit, env, poll_timeout: int, poll_interval: int):
     attempts = 0
     while True:
         attempts += 1
-        try:
-            r = subprocess.run([PY, "-c", PROBE_CODE], env=env,
-                               capture_output=True, text=True,
-                               timeout=poll_timeout)
-        except subprocess.TimeoutExpired:
-            r = None
-        if r is not None and r.returncode == 0 and r.stdout.strip():
+        rc, out, _err, _to = run_sub([PY, "-c", PROBE_CODE], poll_timeout,
+                                     env)
+        if rc == 0 and out.strip():
             if attempts > 1:
                 emit({"event": "tunnel_up",
                       "waited_s": round(time.time() - t0, 1),
@@ -133,7 +161,14 @@ def main() -> int:
                     "answer in ~15-40s; a wedged one just blocks)")
     ap.add_argument("--poll-interval", type=int, default=90,
                     help="sleep between gate probes while wedged")
+    ap.add_argument("--gate", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="probe the tunnel before each experiment; "
+                    "default: on for the built-in on-chip ladder, off "
+                    "for --exps-json (stub tests) — pass --gate with "
+                    "--exps-json for injected ON-CHIP experiment lists")
     args = ap.parse_args()
+    gate = args.gate if args.gate is not None else not args.exps_json
 
     sink = open(args.out, "a", buffering=1)
 
@@ -169,24 +204,20 @@ def main() -> int:
 
     while todo:
         name, argv, timeout, attempt = todo.pop(0)
-        if not args.exps_json:
+        if gate:
             wait_for_tunnel(emit, env, args.poll_timeout,
                             args.poll_interval)
         t0 = time.time()
         emit({"event": "start", "name": name, "attempt": attempt})
-        try:
-            r = subprocess.run(argv, capture_output=True, text=True,
-                               timeout=timeout, env=env, cwd=REPO)
-        except subprocess.TimeoutExpired:
-            r = None
+        rc, out, errout, timed_out = run_sub(argv, timeout, env, cwd=REPO)
         wall = round(time.time() - t0, 1)
-        if r is None or r.returncode != 0:
-            err = (f"timeout after {timeout}s (wedged tunnel?)" if r is None
-                   else f"rc={r.returncode}")
+        if timed_out or rc != 0:
+            err = (f"timeout after {timeout}s (window closed "
+                   "mid-experiment?)" if timed_out else f"rc={rc}")
             rec = {"exp": name, "error": err, "attempt": attempt,
                    "wall_s": wall}
-            if r is not None:
-                rec["tb"] = "; ".join(r.stderr.strip().splitlines()[-4:])
+            if not timed_out:
+                rec["tb"] = "; ".join(errout.strip().splitlines()[-4:])
             # a wedge window can swallow several points in a row, so a
             # failed point goes to the BACK of the queue for up to
             # MAX_ATTEMPTS total tries — later is better than sooner
@@ -199,7 +230,7 @@ def main() -> int:
         # forward every JSON line the experiment printed; non-JSON
         # stdout (bench_attention prints a table) is wrapped verbatim
         got = False
-        for line in r.stdout.splitlines():
+        for line in out.splitlines():
             line = line.strip()
             if not line:
                 continue
