@@ -35,6 +35,7 @@ TPU-native inversion of each piece:
 from __future__ import annotations
 
 import glob
+import json
 import os
 import queue
 import threading
@@ -217,6 +218,14 @@ class ImageNet_data(Dataset):
                 data_dir, self.train_files + self.val_files)
             self.n_train = sum(self._file_sizes[f] for f in self.train_files)
             self.n_val = sum(self._file_sizes[f] for f in self.val_files)
+            # prepared trees carry their label space (classes.json from
+            # prepare_imagenet_from_images); without it keep the
+            # ImageNet default of 1000 rather than guessing from labels
+            # seen in shards (a subset scan could undercount)
+            cj = os.path.join(data_dir, "classes.json")
+            if os.path.exists(cj):
+                with open(cj) as fh:
+                    self.n_classes = len(json.load(fh))
         else:
             self.synthetic = True
             self.n_train = synthetic_n
