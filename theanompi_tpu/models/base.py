@@ -151,6 +151,14 @@ class ModelConfig:
     #: grad_accum_steps, and with steps_per_call (the two stacked
     #: cadences stay mutually exclusive with each other)
     zero_sharding: bool = False
+    #: FSDP (ZeRO-3 class): params AND optimizer state live 1/N per
+    #: device over the data axis; the step is plain global math under
+    #: GSPMD — XLA inserts per-layer all-gathers before each weight's
+    #: use and reduce-scatters for its grads (parallel/fsdp.py).
+    #: Trajectory equals unsharded BSP exactly.  BSP only; composes
+    #: with steps_per_call OR grad_accum_steps; mutually exclusive
+    #: with zero_sharding (FSDP already shards strictly more)
+    fsdp_sharding: bool = False
     seed: int = 42
     data_dir: str | None = None
     snapshot_dir: str = "./snapshots"
@@ -199,6 +207,17 @@ class TpuModel:
         materializes full-size on any device.  ZeRO-1
         (``zero_sharding``) replicates params but builds the optimizer
         state sharded over 'data'."""
+        if self.config.fsdp_sharding:
+            from theanompi_tpu.parallel.fsdp import (fsdp_specs,
+                                                     init_fsdp_state)
+
+            self._check_fsdp_supported()
+            # param_specs doubles as the checkpoint-resume placement
+            # contract (adopt_restored_state re-places params AND the
+            # optimizer's param-like buffers per these specs)
+            self.param_specs = fsdp_specs(params, self.mesh)
+            return init_fsdp_state(params, self.tx, model_state,
+                                   self.mesh, self.param_specs)
         if self.config.zero_sharding:
             from theanompi_tpu.parallel.zero import init_zero_opt_state
 
@@ -210,6 +229,20 @@ class TpuModel:
                               opt_state=opt_state, model_state=ms_r)
         return replicate(TrainState.create(params, self.tx, model_state),
                          self.mesh)
+
+    def _check_psum_grads_only(self, feature: str, how: str) -> None:
+        """Shared guard for the sharding features that ARE the gradient
+        exchange (zero/fsdp): exchange_what/strategy knobs don't apply."""
+        cfg = self.config
+        if cfg.exchange_what != "grads":
+            raise ValueError(f"{feature} IS the gradient exchange; "
+                             "exchange_what='params' does not apply")
+        from theanompi_tpu.parallel.exchanger import resolve_strategy
+
+        if resolve_strategy(cfg.exchange_strategy) != "psum":
+            raise ValueError(
+                f"{feature}'s {how}; the bf16-compressed strategy "
+                f"{cfg.exchange_strategy!r} does not apply")
 
     def _check_zero_supported(self) -> None:
         from theanompi_tpu.parallel.mesh import AXIS_DATA
@@ -224,16 +257,8 @@ class TpuModel:
             raise ValueError("zero_sharding needs an ELEMENTWISE "
                              "optimizer; lars computes layerwise trust "
                              "ratios which a flat shard cannot see")
-        if cfg.exchange_what != "grads":
-            raise ValueError("zero_sharding IS the gradient exchange; "
-                             "exchange_what='params' does not apply")
-        from theanompi_tpu.parallel.exchanger import resolve_strategy
-
-        if resolve_strategy(cfg.exchange_strategy) != "psum":
-            raise ValueError(
-                f"zero_sharding's reduce_scatter runs full-precision; "
-                f"the bf16-compressed strategy "
-                f"{cfg.exchange_strategy!r} does not apply")
+        self._check_psum_grads_only(
+            "zero_sharding", "reduce_scatter runs full-precision")
 
     def _reject_zero_sharding(self, model_kind: str) -> None:
         """Compile-time guard mirroring _reject_grad_accum for models
@@ -241,6 +266,27 @@ class TpuModel:
         if self.config.zero_sharding:
             raise ValueError(f"zero_sharding is not implemented for "
                              f"the {model_kind}")
+        if self.config.fsdp_sharding:
+            raise ValueError(f"fsdp_sharding is not implemented for "
+                             f"the {model_kind}")
+
+    def _check_fsdp_supported(self) -> None:
+        from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+        cfg = self.config
+        if cfg.zero_sharding:
+            raise ValueError("fsdp_sharding already shards params AND "
+                             "optimizer state; combining it with "
+                             "zero_sharding is meaningless")
+        part, axes = self._batch_axes()
+        if axes != (AXIS_DATA,):
+            raise ValueError(
+                f"fsdp_sharding is the pure-DP parameter-sharding path "
+                f"(GSPMD over '{AXIS_DATA}'); this model reduces over "
+                f"{axes} — use the family's own sharded step instead")
+        self._check_psum_grads_only(
+            "fsdp_sharding",
+            "collectives are compiler-inserted at full precision")
 
     def adopt_restored_state(self, state: "TrainState") -> "TrainState":
         """Hook for checkpoint resume: re-establish this model's device
@@ -442,6 +488,33 @@ class TpuModel:
                 "steps_per_call and grad_accum_steps are both stacked-"
                 "batch cadences; combining them by nesting is not "
                 "supported — set one of them to 1")
+        if self.config.fsdp_sharding:
+            from theanompi_tpu.parallel.fsdp import make_bsp_fsdp_step
+
+            self._check_fsdp_supported()
+            # param_specs was derived at state build; passing it keeps
+            # the step's shardings and the resume placement identical
+            fsdp_kw = dict(avg=(sync_type != "cdd"), batch_partition=part,
+                           specs=self.param_specs)
+            self.train_step = make_bsp_fsdp_step(
+                self.loss_fn, self.tx, self.mesh,
+                params_template=self.state.params, **fsdp_kw)
+            if self.config.steps_per_call > 1:
+                self.train_step_multi = make_bsp_fsdp_step(
+                    self.loss_fn, self.tx, self.mesh,
+                    params_template=self.state.params, multi=True,
+                    **fsdp_kw)
+            if self.config.grad_accum_steps > 1:
+                self.train_step_accum = make_bsp_fsdp_step(
+                    self.loss_fn, self.tx, self.mesh,
+                    params_template=self.state.params, accum=True,
+                    **fsdp_kw)
+            # eval reuses the shard_map step: its replicated in_spec
+            # makes jit insert one params all-gather per eval batch
+            self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
+                                                batch_partition=part,
+                                                reduce_axes=axes)
+            return
         if self.config.zero_sharding:
             from theanompi_tpu.parallel.zero import make_bsp_zero_step
 

@@ -28,6 +28,11 @@ from theanompi_tpu.parallel.bsp import (
     make_bsp_eval_step,
     make_bsp_train_step,
 )
+from theanompi_tpu.parallel.fsdp import (
+    fsdp_specs,
+    init_fsdp_state,
+    make_bsp_fsdp_step,
+)
 
 __all__ = [
     "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
@@ -37,4 +42,5 @@ __all__ = [
     "easgd_both_updates", "asgd_apply_grads", "gosgd_merge",
     "gosgd_scale_momentum",
     "TrainState", "make_bsp_train_step", "make_bsp_eval_step",
+    "fsdp_specs", "init_fsdp_state", "make_bsp_fsdp_step",
 ]

@@ -1,0 +1,205 @@
+"""Fully-sharded data parallelism (FSDP / ZeRO-3 class) via GSPMD.
+
+Beyond-parity surface: the reference's four rules all replicate
+parameters on every worker (SURVEY.md §2.11 — its NCCL/MPI exchangers
+move grads or whole param sets); nothing in its zoo shards the
+parameters themselves.  On TPU, parameter sharding is not an exchanger
+subsystem but a PLACEMENT decision handed to the compiler: commit
+every parameter (and therefore its optimizer twin) to a 1/N shard of
+the ``data`` axis, write the training step as the plain unsharded
+math, and let GSPMD insert the all-gathers right before each weight's
+use and a reduce-scatter for its gradient — per-layer, overlapped with
+compute, freed after use.  That per-layer gather/free schedule is what
+hand-written FSDP implementations build manually; XLA derives it from
+the shardings.
+
+Contrast with ``parallel/zero.py`` (ZeRO-1): there the params stay
+replicated and only the flat optimizer vector is sharded, with the
+collectives written out by hand in a ``shard_map``.  Here params,
+momentum, and every param-shaped buffer live sharded at rest —
+per-device state memory drops from ~3P to ~3P/N — and no collective
+appears in the step's source at all.
+
+Design notes:
+
+* Sharding axis per leaf: the LARGEST dim divisible by the data-axis
+  size (ties → earliest dim).  Leaves with no divisible dim (scalars,
+  small biases, odd shapes) stay replicated — they are a vanishing
+  fraction of parameter bytes.
+* The step math is identical to an unsharded single-device step over
+  the global batch, so its oracle in tests is literal: same loss, same
+  params, no tolerance games beyond dtype noise.
+* 'cdd' (sum) semantics: grads of the global-mean loss times N — the
+  same trajectory the shard_map BSP step produces when summing
+  per-shard grads.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from theanompi_tpu.parallel.bsp import (
+    TrainState,
+    accumulate_microbatch_grads,
+    apply_update,
+    grad_and_metrics,
+)
+from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+PyTree = Any
+
+
+def fsdp_specs(params: PyTree, mesh: jax.sharding.Mesh,
+               axis: str = AXIS_DATA) -> PyTree:
+    """Per-leaf PartitionSpecs: shard the largest divisible dim."""
+    n = mesh.shape[axis]
+
+    def spec(leaf) -> P:
+        shape = getattr(leaf, "shape", ())
+        divisible = [d for d in range(len(shape)) if shape[d] % n == 0
+                     and shape[d] >= n]
+        if not divisible:
+            return P()
+        best = max(divisible, key=lambda d: shape[d])
+        return P(*([None] * best + [axis]))
+
+    return jax.tree.map(spec, params)
+
+
+def fsdp_state_sharding(tx: optax.GradientTransformation, params: PyTree,
+                        specs: PyTree, mesh: jax.sharding.Mesh):
+    """TrainState-shaped NamedSharding tree: params per ``specs``,
+    param-like optimizer buffers alongside them (optax.tree_map_params
+    knows which), everything else replicated."""
+
+    def ns(spec_tree):
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    opt_template = jax.eval_shape(tx.init, params)
+    rep = NamedSharding(mesh, P())
+    opt_sharding = optax.tree_map_params(
+        tx, lambda _, s: s, opt_template, ns(specs),
+        transform_non_params=lambda _: rep)
+    # model_state/step: a single replicated sharding acts as a pytree
+    # PREFIX for the whole subtree (jit out_shardings semantics)
+    return TrainState(step=rep, params=ns(specs), opt_state=opt_sharding,
+                      model_state=rep)
+
+
+def init_fsdp_state(params: PyTree, tx: optax.GradientTransformation,
+                    model_state: PyTree, mesh: jax.sharding.Mesh,
+                    specs: PyTree) -> TrainState:
+    """Commit params to their shards, then build the optimizer state
+    FROM the sharded params — ``zeros_like`` inherits sharding, so
+    momentum materializes sharded and full-size optimizer state never
+    exists on any device."""
+    placed = jax.tree.map(
+        lambda x, s: jax.device_put(jnp.asarray(x), NamedSharding(mesh, s)),
+        params, specs)
+    opt_state = jax.jit(tx.init)(placed)
+    rep = NamedSharding(mesh, P())
+    ms = jax.device_put(model_state if model_state is not None else {}, rep)
+    step = jax.device_put(jnp.zeros((), jnp.int32), rep)
+    return TrainState(step=step, params=placed, opt_state=opt_state,
+                      model_state=ms)
+
+
+def make_bsp_fsdp_step(
+    loss_fn,
+    tx: optax.GradientTransformation,
+    mesh: jax.sharding.Mesh,
+    params_template: PyTree,
+    avg: bool = True,
+    donate: bool = True,
+    batch_partition: P = P(AXIS_DATA),
+    multi: bool = False,
+    accum: bool = False,
+    specs: PyTree | None = None,
+):
+    """Build the FSDP training step (plus the stacked cadences).
+
+    ``step(state, batch, rng) -> (state, metrics)`` — the body is the
+    plain global-batch math; all distribution lives in the committed
+    input shardings and the ``out_shardings`` pin that keeps the new
+    state on its shards (without it the partitioner may replicate the
+    updated params, silently un-sharding the state after one step).
+
+    ``multi=True``: ``lax.scan`` of the full step over a stacked batch
+    with per-substep rng folds — same trajectory as k separate calls.
+    ``accum=True``: microbatch gradient accumulation, one update.
+
+    ``batch_partition`` documents the layout the caller stages batches
+    with (``shard_batch``); under GSPMD the step itself needs no
+    per-axis knowledge — it is recorded here so callers share one
+    signature with the shard_map builders.
+    """
+    if accum and multi:
+        raise ValueError("accum and multi are mutually exclusive "
+                         "stacked cadences")
+    n = mesh.shape[AXIS_DATA]
+    # one placement contract: callers that already derived specs (the
+    # model layer stores them as param_specs for checkpoint-resume
+    # re-placement) pass them in, so the step's shardings and the
+    # resume path can never diverge
+    if specs is None:
+        specs = fsdp_specs(params_template, mesh, AXIS_DATA)
+    state_sharding = fsdp_state_sharding(tx, params_template, specs, mesh)
+    # explicit in_shardings, not inference-from-committed-arrays: the
+    # donation matcher pairs donated inputs to outputs by GLOBAL
+    # shape/dtype, so without declared shardings a donated 1/N param
+    # shard can be aliased to a same-global-shape REPLICATED output
+    # (e.g. a BN param vs its batch_stats twin) and the program dies
+    # at runtime with a buffer-size mismatch.  Batch shardings are a
+    # pytree prefix: one sharding covers every batch leaf.
+    batch_spec = (P(None, *batch_partition) if (multi or accum)
+                  else batch_partition)
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    rep = NamedSharding(mesh, P())
+
+    def one_step(state: TrainState, batch, rng):
+        grads, new_ms, metrics = grad_and_metrics(
+            loss_fn, state.params, state.model_state, batch, rng)
+        if not avg:  # 'cdd': sum-of-per-shard-grads trajectory
+            grads = jax.tree.map(lambda g: g * n, grads)
+        return apply_update(tx, state, grads, new_ms), metrics
+
+    if multi:
+        def fn(state, stacked, rng):
+            def body(carry, xs):
+                i, batch = xs
+                return one_step(carry, batch, jax.random.fold_in(rng, i))
+
+            k = jax.tree.leaves(stacked)[0].shape[0]
+            return jax.lax.scan(body, state, (jnp.arange(k), stacked))
+    elif accum:
+        def fn(state, stacked, rng):
+            def add(gsum, grads):
+                return jax.tree.map(
+                    lambda s, g: s + g.astype(jnp.float32), gsum, grads)
+
+            gz = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            new_ms, gsum, metrics, a = accumulate_microbatch_grads(
+                loss_fn, state.params, state.model_state, stacked, rng,
+                gz, add)
+            grads = jax.tree.map(
+                lambda g, p: (g / a).astype(p.dtype), gsum, state.params)
+            if not avg:
+                grads = jax.tree.map(lambda g: g * n, grads)
+            return apply_update(tx, state, grads, new_ms), metrics
+    else:
+        fn = one_step
+
+    return jax.jit(fn,
+                   in_shardings=(state_sharding, batch_sharding, rep),
+                   out_shardings=(state_sharding, None),
+                   donate_argnums=(0,) if donate else ())
+
+
