@@ -127,6 +127,9 @@ def main() -> int:
     ap.add_argument("--zero", action="store_true",
                     help="ZeRO-1: optimizer state sharded over 'data' "
                          "across the process boundary")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="FSDP: params + optimizer state sharded over "
+                         "'data' across the process boundary (GSPMD)")
     ap.add_argument("--donate-race", action="store_true",
                     help="regression (ADVICE r2): async-save sharded "
                          "state, then IMMEDIATELY donate its buffers — "
@@ -179,7 +182,7 @@ def main() -> int:
 
     cfg = ModelConfig(batch_size=8, n_epochs=100, learning_rate=0.05,
                       print_freq=0, snapshot_dir=args.snapshot_dir,
-                      zero_sharding=args.zero)
+                      zero_sharding=args.zero, fsdp_sharding=args.fsdp)
     devs = jax.devices()
     mesh = data_mesh(len(devs), devs)
     model = SmallCifar(config=cfg, mesh=mesh, verbose=False)

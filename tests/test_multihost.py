@@ -240,3 +240,22 @@ def test_two_process_zero_checkpoint_resume(workdir):
     np.testing.assert_allclose(l1, lc[:n], rtol=1e-6)
     np.testing.assert_allclose(l2, lc[n:n + len(l2)], rtol=1e-5,
                                atol=1e-7)
+
+
+@pytest.mark.slow
+def test_two_process_fsdp_matches_plain(workdir):
+    """FSDP across the process boundary: params + optimizer state live
+    1/8 per device SPANNING both processes, GSPMD's gathers and
+    reduce-scatters ride the gloo/DCN collectives, and the trajectory
+    is step-equal to plain BSP."""
+    fsdp = _run_procs(2, port=45727, outdir=workdir, devices_per_proc=4,
+                      epochs=1, extra=["--fsdp"])
+    plain = _run_procs(2, port=45728, outdir=workdir, devices_per_proc=4,
+                       epochs=1)
+    lf = np.array(fsdp[0]["losses"])
+    lp = np.array(plain[0]["losses"])
+    assert len(lf) == len(lp) > 0
+    np.testing.assert_allclose(lf, lp, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(lf, np.array(fsdp[1]["losses"]), rtol=1e-6)
+    assert fsdp[0]["val"]["error"] == pytest.approx(
+        plain[0]["val"]["error"], rel=1e-3, abs=1e-5)
