@@ -82,19 +82,26 @@ def time_shape(b, h, cin, cout, k, stride, dtype, n_iters, fence):
     w = jax.random.normal(jax.random.key(1), (k, k, cin, cout), dtype)
 
     def conv(x, w):
+        # output dtype == operand dtype, mirroring flax nn.Conv as the
+        # models use it (models/resnet50.py dtype=compute_dtype, no
+        # preferred_element_type); a f32 output here would also make
+        # the VJP's transpose conv see a f32 cotangent against bf16
+        # operands, which lax.conv_general_dilated rejects
         return lax.conv_general_dilated(
             x, w, (stride, stride), pad,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=dtype)
 
-    fwd = jax.jit(lambda x, w: conv(x, w).astype(dtype))
+    fwd = jax.jit(conv)
     # fwd+bwd wrt both operands — primal + dgrad + wgrad, like
     # training.  value_and_grad, NOT grad: conv is linear, so under
     # plain grad the primal is dead code (the sum's cotangent is
     # constant ones and neither VJP reads the output) and only 2 of
-    # the 3 GEMMs would be timed.
-    fb = jax.jit(jax.value_and_grad(lambda x, w: conv(x, w).sum(),
-                                    argnums=(0, 1)))
+    # the 3 GEMMs would be timed.  The sum accumulates in f32 so the
+    # scalar stays finite at b=128 sizes.
+    fb = jax.jit(jax.value_and_grad(
+        lambda x, w: conv(x, w).astype(jnp.float32).sum(),
+        argnums=(0, 1)))
 
     def bench(fn):
         out = fn(x, w)
