@@ -168,3 +168,33 @@ def test_lm_train_flops_discounts_experts():
     # top-1 routing: 20 expert weights count as 20/4 active per token
     want = 6 * (10 + 20 // 4) * 2 + 12 * 1 * 2 * 2 * 3
     assert got == float(want)
+
+
+class TestSeqAxisRouting:
+    """A size-1 seq axis must route attention through the fused local
+    path, not a 1-hop ring that materializes the full (B,H,T,T) score
+    matrix (the round-3 on-chip lm_b16_s2048 HBM OOM)."""
+
+    def test_pure_dp_mesh_resolves_to_none(self):
+        mesh = make_training_mesh(MeshSpec(data=8), jax.devices()[:8])
+        m = make_lm(mesh)
+        assert m._resolved_seq_axis() is None
+
+    def test_sp_mesh_keeps_seq_axis(self, dp_sp_mesh):
+        m = make_lm(dp_sp_mesh)
+        assert m._resolved_seq_axis() == "seq"
+
+    def test_pure_dp_never_calls_sequence_attention(self, monkeypatch):
+        import theanompi_tpu.models.transformer as tr
+
+        def boom(*a, **k):
+            raise AssertionError("sequence_attention called on a "
+                                 "size-1 seq axis")
+
+        monkeypatch.setattr(tr, "sequence_attention", boom)
+        mesh = make_training_mesh(MeshSpec(data=8), jax.devices()[:8])
+        m = make_lm(mesh)
+        m.compile_iter_fns("avg")
+        rec = Recorder(rank=0, size=8, print_freq=1000)
+        m.begin_epoch(0)
+        m.train_iter(0, rec)   # would raise through the trace if routed

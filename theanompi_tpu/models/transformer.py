@@ -204,6 +204,25 @@ class TransformerLM(TpuModel):
     def _input_dtype(self):
         return jnp.int32
 
+    def _resolved_seq_axis(self) -> str | None:
+        """The seq axis the step should ACTUALLY shard time over.
+
+        A size-1 ``seq`` axis (any pure-DP mesh — ``data_mesh`` always
+        carries all five named axes) must degrade to ``None`` so
+        attention takes the fused local path (ops/attention.py Pallas
+        kernel) instead of ``ring_attention`` with a 1-hop ring, which
+        materializes the FULL (B, H, T, T) score matrix per block: at
+        b=16 t=2048 that was 768 MB of HLO temp PER BLOCK — the
+        round-3 on-chip lm_b16_s2048 OOM — and a throughput hit at
+        every size.  Ring-with-n=1 and full attention are the same
+        math, so this is a routing fix, not a semantics change
+        (equivalence covered by tests/test_transformer_sp.py).
+        """
+        ax = self.seq_axis
+        if ax is None or self.mesh is None:
+            return ax
+        return ax if dict(self.mesh.shape).get(ax, 1) > 1 else None
+
     def build_data(self):
         c = self._net_cfg
         return SeqLM_data(vocab=c["vocab"], seq_len=c["seq_len"],
@@ -222,7 +241,7 @@ class TransformerLM(TpuModel):
     def loss_fn(self, params, model_state, batch, rng):
         tokens, targets = batch
         logits = self.module.apply({"params": params}, tokens, train=True,
-                                   seq_axis=self.seq_axis,
+                                   seq_axis=self._resolved_seq_axis(),
                                    rngs={"dropout": rng})
         v = logits.shape[-1]
         loss = L.softmax_cross_entropy(logits.reshape(-1, v),
@@ -234,7 +253,7 @@ class TransformerLM(TpuModel):
     def eval_fn(self, params, model_state, batch):
         tokens, targets = batch
         logits = self.module.apply({"params": params}, tokens, train=False,
-                                   seq_axis=self.seq_axis)
+                                   seq_axis=self._resolved_seq_axis())
         v = logits.shape[-1]
         return {"loss": L.softmax_cross_entropy(logits.reshape(-1, v),
                                                 targets.reshape(-1)),
