@@ -35,18 +35,21 @@ N_STEPS = int(os.environ.get("THEANOMPI_TPU_BENCH_STEPS", "30"))
 # scanned multi-step cadence (ModelConfig.steps_per_call): k>1 runs k
 # training iterations per device dispatch — bit-identical trajectory,
 # amortizes the per-dispatch overhead that dominates on the tunnel.
-# Default k=4 adopted from the round-3 ON-CHIP ladder (k in {1,4,8} x
+# Default k adopted from the round-3 ON-CHIP ladder (k in {1,4,8} x
 # batch {128,256} x stem, artifacts/tpu_queue_r03.jsonl): k=4 b=128
-# conv7 won at 2622 img/s/chip vs 2561 at k=1 (+2.4%); b=256 loses
-# 2.5-5.1% per image depending on k (2.45% @k=1, 5.08% @k=4,
-# 2.98% @k=8); k=8 gains nothing over k=4.  The k=4 default applies
-# on the TPU backend ONLY: a round-3 CPU probe found the scanned
-# ResNet body 13x slower per step on the CPU backend (a backend
-# de-optimization, not a trajectory change), so CPU smoke runs keep
-# k=1 unless THEANOMPI_TPU_BENCH_K is set explicitly — the backend
-# check happens in main() after the probe determines the platform.
+# conv7 won at 2622 img/s/chip vs 2561 at k=1 (+2.4%); at b=256 the
+# ordering FLIPS — k=1 is the measured best (2498.36) and k=4 the
+# worst (2488.85) — so the default is per-batch (4 at b<=128, 1
+# above), not a flat 4 (ADVICE r3 #2); k=8 gains nothing anywhere.
+# The k>1 default applies on the TPU backend ONLY: a round-3 CPU
+# probe found the scanned ResNet body 13x slower per step on the CPU
+# backend (a backend de-optimization, not a trajectory change), so
+# CPU smoke runs keep k=1 unless THEANOMPI_TPU_BENCH_K is set
+# explicitly — the backend check happens in main() after the probe
+# determines the platform.
 _BENCH_K_ENV = os.environ.get("THEANOMPI_TPU_BENCH_K")
-STEPS_PER_CALL = int(_BENCH_K_ENV) if _BENCH_K_ENV is not None else 4
+STEPS_PER_CALL = (int(_BENCH_K_ENV) if _BENCH_K_ENV is not None
+                  else (4 if BATCH_PER_CHIP <= 128 else 1))
 if STEPS_PER_CALL < 1:
     raise SystemExit(f"THEANOMPI_TPU_BENCH_K must be >= 1, "
                      f"got {STEPS_PER_CALL}")
@@ -61,9 +64,84 @@ if STEPS_PER_CALL > E2E_STEPS:
     STEPS_PER_CALL = E2E_STEPS
 
 
-PROBE_WINDOW_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_S", "1800"))
+# Probe window default 240 s (round-4: was 1800, which exceeded the
+# DRIVER's own capture timeout — round 3's official record was an
+# rc=124 empty tail because bench.py was still silently probing when
+# the driver's `timeout` killed it.  Long tunnel-patience belongs in
+# tools/run_tpu_queue.py; the driver-invoked path must resolve — with
+# a parseable JSON line either way — inside the driver's patience.
+# Builder-side runs that WANT the long window set
+# THEANOMPI_TPU_BENCH_PROBE_S explicitly.)
+PROBE_WINDOW_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_S", "240"))
 PROBE_ATTEMPT_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_ATTEMPT_S",
                                      "150"))
+# clamped to >=1: a zero/negative cadence would make the wait-slice
+# loop in _run_probe_sub treat every attempt as instantly expired
+HEARTBEAT_S = max(1.0, float(
+    os.environ.get("THEANOMPI_TPU_BENCH_HEARTBEAT_S", "30")))
+
+# Live status for the failure envelope: updated by the probe loop and
+# the measurement legs, read by the SIGTERM/SIGINT handler so a killed
+# run still emits one parseable JSON line (round-3 verdict #1).
+_STATUS = {"phase": "startup", "probe_attempts": 0, "last_error": "",
+           "t0": time.monotonic()}
+_CURRENT_SUB = None  # Popen of the in-flight probe, for cleanup on kill
+
+
+def _failure_json(reason: str) -> str:
+    return json.dumps({
+        "metric": "resnet50_imagenet_bsp_images_per_sec_per_chip",
+        "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+        "detail": {
+            "error": reason,
+            "phase": _STATUS["phase"],
+            "probe_attempts": _STATUS["probe_attempts"],
+            "last_error": _STATUS["last_error"],
+            "elapsed_s": round(time.monotonic() - _STATUS["t0"], 1),
+            "note": "no measurement taken — last verified on-chip "
+                    "numbers: BASELINE.md 'Measured' table",
+        },
+    })
+
+
+def _install_kill_handler() -> None:
+    """SIGTERM/SIGINT → flush a failure JSON line, then exit 1.
+
+    The driver wraps bench.py in `timeout`, which SIGTERMs (then
+    SIGKILLs) on expiry.  Round 3 died holding its output: stdout had
+    nothing when the TERM landed, so the official record was an
+    unparseable empty tail.  The handler makes every exit path emit
+    exactly one JSON line; SIGKILL is the only unhandleable case, and
+    the stderr heartbeat (below) leaves a diagnostic tail even then."""
+    import signal
+
+    def on_kill(signum, frame):
+        sig = signal.Signals(signum).name
+        try:
+            if _CURRENT_SUB is not None:
+                os.killpg(_CURRENT_SUB.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        if _STATUS["phase"] == "done":
+            # success line already printed; a TERM landing during
+            # interpreter/plugin teardown must not append a second
+            # (failure) JSON line that a last-line parser would take
+            os._exit(0)
+        print(_failure_json(f"killed by {sig} during "
+                            f"phase={_STATUS['phase']}"), flush=True)
+        # plain exit, not sys.exit: the handler may interrupt arbitrary
+        # frames (incl. finally blocks that would swallow SystemExit)
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, on_kill)
+    signal.signal(signal.SIGINT, on_kill)
+
+
+def _heartbeat(msg: str) -> None:
+    """One line to STDERR — never stdout, which must stay a single
+    JSON line — so a killed run leaves a human-readable tail."""
+    el = time.monotonic() - _STATUS["t0"]
+    print(f"[bench +{el:.0f}s] {msg}", file=sys.stderr, flush=True)
 
 
 def _run_probe_sub(argv, timeout):
@@ -74,22 +152,38 @@ def _run_probe_sub(argv, timeout):
     inherit the stdout pipe, so after the timeout kill the internal
     ``communicate()`` blocks forever on a pipe the orphans hold open
     (observed live in round 3: a 150 s probe still "running" at 9 min).
-    Returns (rc, stdout, stderr, timed_out)."""
+    Waits in <=HEARTBEAT_S slices, emitting a stderr status line per
+    slice.  Returns (rc, stdout, stderr, timed_out)."""
     import signal
     import tempfile
 
+    global _CURRENT_SUB
     with tempfile.TemporaryFile() as fo, tempfile.TemporaryFile() as fe:
         p = subprocess.Popen(argv, stdout=fo, stderr=fe,
                              start_new_session=True)
-        try:
-            rc, timed_out = p.wait(timeout=timeout), False
-        except subprocess.TimeoutExpired:
-            rc, timed_out = None, True
+        _CURRENT_SUB = p
+        deadline = time.monotonic() + timeout
+        rc, timed_out = None, False
+        while True:
+            slice_s = min(HEARTBEAT_S, deadline - time.monotonic())
+            if slice_s <= 0:
+                timed_out = True
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                p.wait()
+                break
             try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            p.wait()
+                rc = p.wait(timeout=slice_s)
+                break
+            except subprocess.TimeoutExpired:
+                _heartbeat(
+                    f"probe attempt {_STATUS['probe_attempts']} still "
+                    f"waiting on device init "
+                    f"({deadline - time.monotonic():.0f}s left in "
+                    "attempt)")
+        _CURRENT_SUB = None
         fo.seek(0)
         fe.seek(0)
         return (rc, fo.read().decode(errors="replace"),
@@ -130,6 +224,9 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
             return None, (f"{last_err} — gave up after {attempts} "
                           f"attempt(s) in a {window_s}s window")
         attempts += 1
+        _STATUS["probe_attempts"] = attempts
+        _heartbeat(f"probe attempt {attempts} starting "
+                   f"({remaining:.0f}s left in window)")
         rc, stdout, stderr, timed_out = _run_probe_sub(
             [sys.executable, "-c", code],
             timeout=min(PROBE_ATTEMPT_S, remaining))
@@ -139,6 +236,7 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
             # succeeds, so kill, wait, re-probe until the window ends
             last_err = (f"device init hung past {PROBE_ATTEMPT_S}s "
                         "(wedged tunnel?)")
+            _STATUS["last_error"] = last_err
             time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
             continue
         out = stdout.strip().splitlines()
@@ -161,6 +259,8 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
         if any(s in err for s in deterministic):
             return None, f"{err} — not retrying (misconfig, not a wedge)"
         last_err = err
+        _STATUS["last_error"] = last_err
+        _heartbeat(f"probe attempt {attempts} failed: {err[:120]}")
         # back off, but never sleep away the final attempt's window —
         # the post-UNAVAILABLE recovery attempt is the whole point
         remaining = deadline - time.monotonic()
@@ -178,22 +278,19 @@ def fenced_loss(metrics) -> float:
 
 
 def main() -> int:
+    _install_kill_handler()
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         platform, err = "cpu", ""  # no tunnel involved; probe is moot
     else:
+        _STATUS["phase"] = "probe"
         platform, err = _probe_backend()
     if platform is None:
-        print(json.dumps({
-            "metric": "resnet50_imagenet_bsp_images_per_sec_per_chip",
-            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
-            "detail": {
-                "error": f"no measurement taken — {err}; last verified "
-                         "on-chip numbers: BASELINE.md 'Measured' table",
-            },
-        }))
+        print(_failure_json(f"no measurement taken — {err}"), flush=True)
         return 1
+    _STATUS["phase"] = f"measure ({platform})"
+    _heartbeat(f"backend up: {platform}; building model")
 
     from theanompi_tpu.models.base import ModelConfig
     from theanompi_tpu.models.resnet50 import ResNet50
@@ -240,10 +337,14 @@ def main() -> int:
 
     rng = jax.random.key(0)
     state = model.state
+    _STATUS["phase"] = "compile+warmup"
+    _heartbeat("compiling the training step (first compile ~20-40s)")
     for i in range(3):  # warmup: compile + steady state
         state, metrics = step_fn(state, staged[i % len(staged)], rng)
     fenced_loss(metrics)
 
+    _STATUS["phase"] = "device-step leg"
+    _heartbeat("warm; timing the device-step leg")
     n_steps = max(1, N_STEPS // k)  # dispatches; each covers k iters
     t0 = time.perf_counter()
     for i in range(n_steps):
@@ -262,6 +363,7 @@ def main() -> int:
     # TPU VM), which caps the e2e leg far below the device step; the
     # explicit ceiling keeps the e2e fraction honest instead of
     # looking like a pipeline bug.
+    _STATUS["phase"] = "h2d probe"
     probe = next(model.data.train_batches(0, global_batch))
     probe_bytes = sum(np.asarray(a).nbytes for a in jax.tree.leaves(probe))
 
@@ -296,6 +398,8 @@ def main() -> int:
     # ---- leg 2: end-to-end through the real pipeline ----
     # train_iter covers k iterations per dispatch when steps_per_call
     # is on, so drive by consumed count like rules/bsp.py does
+    _STATUS["phase"] = "e2e leg"
+    _heartbeat(f"device step {step_per_chip:.0f} img/s/chip; e2e leg")
     recorder = Recorder(rank=0, size=n_chips, print_freq=0)
     n_iters = min(model.begin_epoch(0), E2E_STEPS)
     n_iters -= n_iters % k
@@ -342,7 +446,8 @@ def main() -> int:
             "augment": "device",
             "backend": jax.default_backend(),
         },
-    }))
+    }), flush=True)
+    _STATUS["phase"] = "done"
     return 0
 
 

@@ -72,7 +72,8 @@ class TinyRecipeResNet(ResNet50):
         return ResNet(stage_sizes=(1, 1, 1, 1), width=8,
                       n_classes=self.data.n_classes,
                       dtype=self._compute_dtype(),
-                      stem=self.config.resnet_stem)
+                      stem=self.config.resnet_stem,
+                      bn_axis=self._bn_axis())
 
     def build_data(self):
         return ImageNet_data(crop=32, seed=self.config.seed,

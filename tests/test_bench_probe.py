@@ -146,6 +146,136 @@ def test_probe_hang_then_recovery_is_caught(monkeypatch):
     assert len(calls) == 3
 
 
+FAKE_JAX = '''\
+"""Fake jax for bench envelope tests: imports fine, device init hangs
+forever — the observable signature of a wedged axon tunnel."""
+import time
+
+
+class _Cfg:
+    def update(self, *a, **k):
+        pass
+
+
+config = _Cfg()
+
+
+def devices(*a, **k):
+    time.sleep(600)
+
+
+def default_backend():
+    return "fake"
+
+
+def __getattr__(name):  # PEP 562: any other attr is a harmless no-op
+    def _noop(*a, **k):
+        return _noop
+    return _noop
+'''
+
+
+def _bench_env(tmp_path, **extra):
+    import os
+
+    (tmp_path / "jax.py").write_text(FAKE_JAX)
+    env = dict(os.environ)
+    # without POOL_IPS the image's sitecustomize touches nothing, so
+    # the fake jax shadows the real one cleanly in every child
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = (str(tmp_path) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env.update(extra)
+    return env
+
+
+def _repo_root():
+    import pathlib
+
+    return str(pathlib.Path(bench.__file__).parent)
+
+
+def _parse_stdout_json(stdout):
+    import json
+
+    lines = [ln for ln in stdout.splitlines() if ln.lstrip().startswith("{")]
+    assert lines, f"no JSON line on stdout; got: {stdout!r}"
+    return json.loads(lines[-1])
+
+
+def test_default_probe_window_fits_driver_patience():
+    """Round 3's record was an rc=124 empty tail because the default
+    1800 s probe window exceeded the driver's own capture timeout.
+    The driver-invoked default must resolve well inside it."""
+    import subprocess as sp
+
+    out = sp.run([sys.executable, "-c",
+                  "import bench; print(bench.PROBE_WINDOW_S)"],
+                 capture_output=True, text=True, cwd=_repo_root(),
+                 env=_bench_env_no_override(), timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert int(out.stdout.strip()) <= 240
+
+
+def _bench_env_no_override():
+    import os
+
+    env = dict(os.environ)
+    env.pop("THEANOMPI_TPU_BENCH_PROBE_S", None)
+    return env
+
+
+@pytest.mark.slow
+def test_sigterm_mid_probe_flushes_failure_json(tmp_path):
+    """THE round-3 failure mode, reproduced end-to-end: driver-style
+    `timeout -s TERM` lands while bench is blocked probing a wedged
+    tunnel.  The kill handler must flush one parseable JSON line to
+    stdout (and the heartbeat a diagnostic tail to stderr) instead of
+    dying output-empty."""
+    env = _bench_env(
+        tmp_path,
+        THEANOMPI_TPU_BENCH_PROBE_S="600",
+        THEANOMPI_TPU_BENCH_HEARTBEAT_S="1",
+    )
+    p = subprocess.run(
+        ["timeout", "-s", "TERM", "6", sys.executable, "bench.py"],
+        capture_output=True, text=True, cwd=_repo_root(), env=env,
+        timeout=90)
+    # `timeout` exits 124 whenever the limit fired, even when the child
+    # handled the TERM and exited on its own; 137 means it had to
+    # escalate to SIGKILL — i.e. our handler wedged — which is the one
+    # unacceptable outcome
+    assert p.returncode != 137, (
+        f"timeout escalated to SIGKILL; stderr tail: {p.stderr[-500:]}")
+    obj = _parse_stdout_json(p.stdout)
+    assert obj["value"] == 0.0 and obj["unit"] == "images/sec/chip"
+    assert "killed by SIGTERM" in obj["detail"]["error"]
+    assert obj["detail"]["phase"] == "probe"
+    assert obj["detail"]["probe_attempts"] >= 1
+    assert "[bench +" in p.stderr  # heartbeat tail survived the kill
+
+
+@pytest.mark.slow
+def test_exhausted_window_emits_failure_json(tmp_path):
+    """No TERM involved: a wedge that outlasts the whole (short)
+    window must still end in rc=1 + one parseable JSON line."""
+    env = _bench_env(
+        tmp_path,
+        THEANOMPI_TPU_BENCH_PROBE_S="5",
+        THEANOMPI_TPU_BENCH_PROBE_ATTEMPT_S="2",
+        THEANOMPI_TPU_BENCH_HEARTBEAT_S="1",
+    )
+    p = subprocess.run([sys.executable, "bench.py"],
+                       capture_output=True, text=True, cwd=_repo_root(),
+                       env=env, timeout=90)
+    assert p.returncode == 1
+    obj = _parse_stdout_json(p.stdout)
+    assert obj["value"] == 0.0
+    assert "hung past" in obj["detail"]["error"]
+    assert obj["detail"]["probe_attempts"] >= 1
+
+
 def test_run_probe_sub_real_timeout_kills_group():
     """The file-backed runner must return on timeout even when the
     child's own child keeps the (nonexistent) pipe alive — the exact

@@ -180,7 +180,10 @@ class TestResNet50LargeBatch:
         cfg = ResNet50_LargeBatch.default_config()
         assert (cfg.optimizer, cfg.lr_schedule) == ("lars", "cosine")
         assert cfg.warmup_epochs == 5 and cfg.resnet_stem == "s2d"
-        assert cfg.batch_size == 256 and cfg.compute_dtype == "bfloat16"
+        # b=128/chip is the measured-best point of the round-3 on-chip
+        # ladder (b=256 lost at every k); the 8k+ global batch of the
+        # published LARS recipes comes from the shard count
+        assert cfg.batch_size == 128 and cfg.compute_dtype == "bfloat16"
 
     def test_lars_s2d_trains_width_scaled(self, mesh8):
         """The recipe's moving parts (LARS + warmup + s2d stem) drive

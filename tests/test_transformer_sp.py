@@ -196,5 +196,8 @@ class TestSeqAxisRouting:
         m = make_lm(mesh)
         m.compile_iter_fns("avg")
         rec = Recorder(rank=0, size=8, print_freq=1000)
-        m.begin_epoch(0)
-        m.train_iter(0, rec)   # would raise through the trace if routed
+        try:
+            m.begin_epoch(0)
+            m.train_iter(0, rec)   # would raise through trace if routed
+        finally:
+            m.cleanup()

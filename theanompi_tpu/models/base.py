@@ -129,6 +129,17 @@ class ModelConfig:
     #: (exact space-to-depth re-parameterization — the TPU-friendly
     #: shape for the C=3 stem conv; models/resnet50.py)
     resnet_stem: str = "conv7"
+    #: cross-replica BatchNorm: compute BN batch statistics over the
+    #: whole DATA axis (lax.pmean inside the BN, flax ``axis_name``)
+    #: instead of per-shard.  The standard TPU-pod choice when the
+    #: per-core batch is small (running stats from a 4-8 image shard
+    #: are too noisy to serve eval — observed as chance-level val error
+    #: with converged train loss).  Per-shard BN (False) matches the
+    #: reference's per-worker semantics.  Requires a shard_map step
+    #: with a live 'data' axis — incompatible with fsdp_sharding
+    #: (GSPMD jit has no named axes; compile_iter_fns rejects the
+    #: combination)
+    sync_bn: bool = False
     #: rematerialize transformer blocks in the backward pass
     #: (jax.checkpoint): activations are recomputed instead of stored,
     #: trading ~1/3 more FLOPs for O(n_layers) less activation HBM —
@@ -381,6 +392,16 @@ class TpuModel:
         return (jnp.bfloat16 if self.config.compute_dtype == "bfloat16"
                 else jnp.float32)
 
+    def _bn_axis(self) -> str | None:
+        """Named axis for cross-replica BN stats (ModelConfig.sync_bn);
+        None keeps per-shard stats.  BN-using build_module()s pass this
+        to their module so one config knob covers the family."""
+        if not self.config.sync_bn:
+            return None
+        from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+        return AXIS_DATA
+
     # -- optimizer / loss ----------------------------------------------------
 
     def _build_optimizer(self, lr: float) -> optax.GradientTransformation:
@@ -492,6 +513,11 @@ class TpuModel:
             from theanompi_tpu.parallel.fsdp import make_bsp_fsdp_step
 
             self._check_fsdp_supported()
+            if self.config.sync_bn:
+                raise ValueError(
+                    "sync_bn needs a shard_map step with a named 'data' "
+                    "axis; the FSDP step is GSPMD-jitted with no named "
+                    "axes — use per-shard BN (sync_bn=False) with FSDP")
             # param_specs was derived at state build; passing it keeps
             # the step's shardings and the resume placement identical
             fsdp_kw = dict(avg=(sync_type != "cdd"), batch_partition=part,

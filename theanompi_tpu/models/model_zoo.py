@@ -52,11 +52,21 @@ class ResNet50_LargeBatch(ResNet50):
         from theanompi_tpu.models.base import ModelConfig
 
         return ModelConfig(
-            batch_size=256,
+            # per-chip batch 128, measured: the round-3 on-chip ladder
+            # (artifacts/tpu_queue_r03.jsonl, BASELINE.md table) ran
+            # b/chip in {128,256} x k in {1,4,8} and b=256 LOST at
+            # every k (-2.45% to -5.08% img/s/chip) — N<=256 lane-bound
+            # conv GEMMs don't gain from doubling M while the 2x
+            # activations pressure HBM.  The published LARS recipes'
+            # 8k-32k GLOBAL batch comes from the shard count (128/chip
+            # x 64+ chips), not from a big per-chip batch, so the
+            # large-batch geometry is preserved where it matters.
+            batch_size=128,
             # per-shard master LR; sqrt scaling with the data-shard
             # count keeps the LARS LR in its working range at every
-            # mesh size (0.7 on 1 chip -> ~4 at 32 shards / 8k global
-            # batch, the regime the published LARS recipes tune for)
+            # mesh size (0.7 on 1 chip -> ~5.6 at 64 shards / 8k
+            # global batch, the regime the published LARS recipes
+            # tune for)
             learning_rate=0.7,
             lr_scale_with_workers="sqrt",
             n_epochs=90,

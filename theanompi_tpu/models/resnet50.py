@@ -31,12 +31,16 @@ class BottleneckBlock(nn.Module):
     features: int            # bottleneck width; output is 4x this
     strides: tuple[int, int] = (1, 1)
     dtype: jnp.dtype = jnp.float32
+    #: named mesh axis to pmean BN stats over (cross-replica BN);
+    #: None = per-shard stats (the reference's per-worker semantics)
+    bn_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool):
         norm = lambda scale_init=nn.initializers.ones: nn.BatchNorm(  # noqa: E731
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
-            dtype=self.dtype, scale_init=scale_init)
+            dtype=self.dtype, scale_init=scale_init,
+            axis_name=self.bn_axis)
         out_features = self.features * 4
 
         residual = x
@@ -98,6 +102,8 @@ class ResNet(nn.Module):
     n_classes: int = 1000
     dtype: jnp.dtype = jnp.float32
     stem: str = "conv7"          # 'conv7' | 's2d'
+    #: cross-replica BN axis (ModelConfig.sync_bn); None = per-shard
+    bn_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -118,14 +124,15 @@ class ResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype, name="stem_bn")(x)
+                         epsilon=1e-5, dtype=self.dtype, name="stem_bn",
+                         axis_name=self.bn_axis)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
                 x = BottleneckBlock(self.width * (2 ** stage), strides,
-                                    self.dtype)(x, train)
+                                    self.dtype, self.bn_axis)(x, train)
         x = L.global_avg_pool(x)
         x = L.Dense(self.n_classes, kernel_init=L.xavier_init())(x)
         return x.astype(jnp.float32)
@@ -163,7 +170,8 @@ class ResNet50(TpuModel):
         return ResNet(stage_sizes=self.stage_sizes,
                       n_classes=self.data.n_classes,
                       dtype=self._compute_dtype(),
-                      stem=self.config.resnet_stem)
+                      stem=self.config.resnet_stem,
+                      bn_axis=self._bn_axis())
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir,

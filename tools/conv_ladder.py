@@ -143,8 +143,13 @@ def main() -> int:
         if sink:
             sink.write(line + "\n")
 
+    # out_dtype tags every row: v1 of this tool emitted f32 conv
+    # outputs (+cast), v2 emits operand-dtype outputs — rows from the
+    # two generations in one JSONL are not directly comparable, so
+    # each row says which regime produced it (ADVICE r3 #3)
     emit({"event": "ladder_start", "backend": jax.default_backend(),
-          "batch": args.batch, "stem": args.stem, "dtype": args.dtype})
+          "batch": args.batch, "stem": args.stem, "dtype": args.dtype,
+          "out_dtype": args.dtype, "tool_version": 2})
     total_fwd = total_fb = total_gflops = 0.0
     for (name, b, h, cin, cout, k, stride, count) in resnet50_convs(
             args.batch, args.stem):
@@ -156,6 +161,7 @@ def main() -> int:
         total_gflops += count * g
         emit({"conv": name, "h_in": h, "cin": cin, "cout": cout,
               "k": k, "stride": stride, "count": count,
+              "out_dtype": args.dtype,
               "gflops_fwd": round(g, 2),
               "fwd_ms": round(fwd_ms, 3), "fwdbwd_ms": round(fb_ms, 3),
               "tflops_fwd": round(g / fwd_ms, 2),
