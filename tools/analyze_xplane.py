@@ -37,22 +37,70 @@ from collections import defaultdict
 
 _SHAPE_RE = re.compile(r"\[(\d+),(\d+),(\d+),(\d+)\]")
 
+#: Rows whose total duration per step is below this are too short for the
+#: flops/bytes counters to produce meaningful rates (the r4 account printed
+#: 5.77e6 GB/s for async-start); their rates are suppressed and flagged.
+SUB_RESOLUTION_MS = 0.05
 
-def conv_spatial_bucket(hlo_text: str) -> str:
-    """Bucket a conv fusion by the first NHWC shape in its HLO text —
-    a proxy for ResNet stage (56/28/14/7 spatial).  'other' when no
-    4-D shape appears."""
-    m = _SHAPE_RE.search(hlo_text)
-    if not m:
+
+def hlo_output_part(hlo_text: str) -> str:
+    """The output-shape side of ``%name = <shapes> op(operands…)`` —
+    text before the operand list (shared with tools/fusion_deepdive.py
+    so the two tools can't silently diverge on output parsing)."""
+    return hlo_text.split(" fusion(")[0] if " fusion(" in hlo_text \
+        else hlo_text.split("(")[0]
+
+
+def conv_spatial_bucket(hlo_text: str, tf_op: str = "") -> str:
+    """Bucket a conv fusion by its ACTIVATION shape + pass kind.
+
+    The round-4 account used the first 4-D shape in the HLO text, which
+    for weight-gradient convs is the *kernel* (e.g. ``[1,1,64,256]``) —
+    ~8%% of the step was mis-attributed to kernel-shaped "activation"
+    buckets (round-4 verdict, weak #3).  This version:
+
+    - finds every 4-D shape in the text, takes the batch dim as the
+      leading dim of the largest shape by element count (the streamed
+      activation; a modal-leading-dim rule fails on wgrad fusions that
+      fold the optimizer update and so mention the kernel shape 4x),
+    - buckets by the batch-led shape with the largest spatial extent
+      (the activation actually streamed from HBM), labelled HxWxC,
+    - classifies the pass: ``wgrad`` when the op's *output* contains a
+      4-D shape that is NOT batch-led (the kernel gradient), ``dgrad``
+      when the JAX source path shows ``transpose(`` (reverse-mode),
+      else ``fprop``.
+
+    Returns ``"HxWxC:kind"`` so the bucket table still sums to the conv
+    category total, or ``"other"`` when no 4-D shape appears.
+    """
+    shapes = [tuple(int(g) for g in m.groups())
+              for m in _SHAPE_RE.finditer(hlo_text)]
+    if not shapes:
         return "other"
-    n, h, w, c = (int(g) for g in m.groups())
-    return f"{h}x{w}x{c}"
+    batch = max(shapes, key=lambda s: s[0] * s[1] * s[2] * s[3])[0]
+    acts = [s for s in shapes if s[0] == batch]
+    if acts:
+        n, h, w, c = max(acts, key=lambda s: (s[1] * s[2], s[3]))
+    else:
+        n, h, w, c = shapes[0]
+    out_part = hlo_output_part(hlo_text)
+    out_shapes = [tuple(int(g) for g in m.groups())
+                  for m in _SHAPE_RE.finditer(out_part)]
+    if out_shapes and all(s[0] != batch for s in out_shapes):
+        kind = "wgrad"
+    elif "transpose(" in tf_op:
+        kind = "dgrad"
+    else:
+        kind = "fprop"
+    return f"{h}x{w}x{c}:{kind}"
 
 
 def aggregate(events: list[dict], n_steps: int) -> dict:
-    """events: [{name, display, category, dur_ps, flops, bytes}] over
-    ``n_steps`` captured steps.  Returns {categories, conv_buckets,
-    top_ops, totals} with per-STEP ms and measured rates."""
+    """events: [{name, display, category, dur_ps, flops, bytes,
+    tf_op?}] over ``n_steps`` captured steps.  Returns {categories,
+    conv_buckets, top_ops, totals} with per-STEP ms and measured rates.
+    Rows shorter than ``SUB_RESOLUTION_MS`` per step carry
+    ``rates_unreliable: true`` and suppressed (0.0) rates."""
     cats = defaultdict(lambda: [0, 0, 0, 0])       # dur, flops, bytes, n
     convs = defaultdict(lambda: [0, 0, 0, 0])
     ops = defaultdict(lambda: [0, 0, 0, 0, ""])
@@ -67,7 +115,7 @@ def aggregate(events: list[dict], n_steps: int) -> dict:
             if table is ops:
                 a[4] = e["category"]
         if e["category"] == "convolution fusion":
-            a = convs[conv_spatial_bucket(e["name"])]
+            a = convs[conv_spatial_bucket(e["name"], e.get("tf_op", ""))]
             a[0] += e["dur_ps"]
             a[1] += e["flops"]
             a[2] += e["bytes"]
@@ -76,11 +124,15 @@ def aggregate(events: list[dict], n_steps: int) -> dict:
     def row(d, f, b, n, *extra):
         ms = d / 1e9 / n_steps
         sec = d / 1e12
+        unreliable = ms < SUB_RESOLUTION_MS
         return {
             "ms_per_step": round(ms, 3),
-            "tflops_per_s": round(f / sec / 1e12, 1) if d else 0.0,
-            "gbytes_per_s": round(b / sec / 1e9, 1) if d else 0.0,
+            "tflops_per_s": (round(f / sec / 1e12, 1)
+                             if d and not unreliable else 0.0),
+            "gbytes_per_s": (round(b / sec / 1e9, 1)
+                             if d and not unreliable else 0.0),
             "events_per_step": n // n_steps,
+            **({"rates_unreliable": True} if unreliable else {}),
             **({"category": extra[0]} if extra else {}),
         }
 
@@ -118,10 +170,15 @@ def roofline(report: dict, peak_tflops: float, peak_hbm_gbps: float) -> dict:
     intensity."""
     out = {}
     for k, c in report["categories"].items():
+        if c.get("rates_unreliable"):
+            out[k] = {"hbm_fraction": None, "mxu_fraction": None,
+                      "hbm_implied_tflops_ceiling": None,
+                      "rates_unreliable": True}
+            continue
         gbs, tfs = c["gbytes_per_s"], c["tflops_per_s"]
         hbm_frac = gbs / peak_hbm_gbps if peak_hbm_gbps else 0.0
         implied = tfs / hbm_frac if hbm_frac > 0 else float("inf")
-        out[k] = {
+        entry = {
             "hbm_fraction": round(hbm_frac, 3),
             "mxu_fraction": round(tfs / peak_tflops, 3)
             if peak_tflops else 0.0,
@@ -129,7 +186,36 @@ def roofline(report: dict, peak_tflops: float, peak_hbm_gbps: float) -> dict:
                                            if implied != float("inf")
                                            else None),
         }
+        # bytes_accessed counts every operand touch, including
+        # VMEM-resident re-reads and async waits charged against tiny
+        # on-stream durations — a "fraction" well past peak is an
+        # accounting artifact, not a measurement of HBM streaming.
+        if hbm_frac > 1.25:
+            entry["accounting_artifact"] = True
+            entry["hbm_implied_tflops_ceiling"] = None
+        out[k] = entry
     return out
+
+
+def pick_n_steps(line_event_counts: dict) -> int:
+    """Number of captured steps from a {line_name: n_events} map.
+
+    Prefers the 'XLA Modules' line (one event per module execution);
+    falls back to 'Steps'; with neither, warns and returns 1 so the
+    per-step columns are at least labelled honestly as per-capture.
+    (Round-4 advisor: the old truthiness one-liner silently collapsed
+    both absent and empty to 1 with no warning.)
+    """
+    n = line_event_counts.get("XLA Modules", 0)
+    if n:
+        return n
+    n = line_event_counts.get("Steps", 0)
+    if n:
+        return n
+    print("WARNING: no 'XLA Modules'/'Steps' line in this capture — "
+          "treating the whole capture as ONE step; per-step columns "
+          "are really per-capture", file=sys.stderr)
+    return 1
 
 
 # -- proto extraction -----------------------------------------------------
@@ -177,8 +263,7 @@ def extract_device_events(space) -> tuple[list[dict], int, dict]:
             info[n] = stat_val(s)
 
     lines = {ln.name: ln for ln in plane.lines}
-    n_steps = len(lines["XLA Modules"].events) if "XLA Modules" in lines \
-        else max(1, len(lines.get("Steps", ()) and lines["Steps"].events))
+    n_steps = pick_n_steps({ln.name: len(ln.events) for ln in plane.lines})
     events = []
     for e in lines["XLA Ops"].events:
         md = em[e.metadata_id]
@@ -190,6 +275,7 @@ def extract_device_events(space) -> tuple[list[dict], int, dict]:
             "dur_ps": e.duration_ps,
             "flops": st.get("flops", 0) or 0,
             "bytes": st.get("bytes_accessed", 0) or 0,
+            "tf_op": st.get("tf_op", "") or "",
         })
     return events, n_steps, info
 
@@ -228,11 +314,17 @@ def main() -> int:
     for k, c in report["categories"].items():
         r = rl[k]
         ceil = r["hbm_implied_tflops_ceiling"]
+        if c.get("rates_unreliable"):
+            print(f"{k[:25]:<26}{c['ms_per_step']:9.3f}{c['pct']:7.1f}"
+                  f"{'(sub-resolution: rates suppressed)':>40}")
+            continue
+        frac = r["hbm_fraction"] or 0.0
+        art = "*" if r.get("accounting_artifact") else ""
         print(f"{k[:25]:<26}{c['ms_per_step']:9.3f}{c['pct']:7.1f}"
               f"{c['tflops_per_s']:8.1f}{c['gbytes_per_s']:8.0f}"
-              f"{100 * r['hbm_fraction']:7.1f}"
+              f"{100 * frac:6.1f}{art:1}"
               f"{(f'{ceil:10.1f}' if ceil else '         -')}")
-    print(f"\n{'conv bucket (HxWxC)':<26}{'ms/step':>9}{'%':>7}"
+    print(f"\n{'conv bucket (HxWxC:kind)':<26}{'ms/step':>9}{'%':>7}"
           f"{'TF/s':>8}{'GB/s':>8}")
     for k, c in report["conv_buckets"].items():
         print(f"{k:<26}{c['ms_per_step']:9.3f}{c['pct']:7.1f}"
