@@ -178,3 +178,47 @@ def test_prepare_then_train_one_epoch(tmp_path, mesh8):
     assert losses[-1] < losses[0], f"no learning on real shards: {losses}"
     # color IS the class: 15 steps must beat chance (2/3) on val
     assert errs[-1] < 0.67, f"val stuck at chance: {errs}"
+
+
+def test_gather_assembly_matches_naive_reference(tmp_path):
+    """The round-5 single-gather batch assembly (_file_batches) must
+    produce byte-identical batches to the naive materialize-and-
+    concatenate formulation it replaced, including across unequal
+    shard boundaries and with the seeded in-shard shuffle."""
+    import numpy as np
+
+    from theanompi_tpu.data.imagenet import ImageNet_data, _write_shard
+
+    rng = np.random.default_rng(7)
+    sizes = [8, 5, 8, 3]  # unequal shards force multi-part batches
+    xs, ys = [], []
+    for i, n in enumerate(sizes):
+        x = rng.integers(0, 256, size=(n, 12, 12, 3), dtype=np.uint8)
+        y = rng.integers(0, 10, size=n).astype(np.int64)
+        _write_shard(str(tmp_path), "train", i, x, y, "npy")
+        xs.append(x)
+        ys.append(y)
+
+    ds = ImageNet_data(data_dir=str(tmp_path), crop=12, seed=3,
+                       augment_on_device=True)  # raw uint8: exact compare
+    B = 6
+    got = list(ds.train_batches(epoch=0, global_batch=B))
+
+    # naive reference: same file order, same per-shard permutation
+    # stream, materialized then concatenated then sliced
+    files = ds._sharded_files(ds.train_files, 0, 0, 1)
+    order = {f: i for i, f in enumerate(
+        str(tmp_path) + f"/train_{i:04d}.x.npy" for i in range(4))}
+    shuf = np.random.default_rng(ds.seed + 9000 + 7919 * 0 + 0)
+    all_x, all_y = [], []
+    for f in files:
+        i = order[f]
+        p = shuf.permutation(len(ys[i]))
+        all_x.append(xs[i][p])
+        all_y.append(ys[i][p])
+    cat_x, cat_y = np.concatenate(all_x), np.concatenate(all_y)
+    n_batches = len(cat_y) // B
+    assert len(got) == n_batches
+    for b, (xb, yb) in enumerate(got):
+        np.testing.assert_array_equal(xb, cat_x[b * B:(b + 1) * B])
+        np.testing.assert_array_equal(yb, cat_y[b * B:(b + 1) * B])

@@ -1,0 +1,60 @@
+"""DevicePrefetcher: staging, exhaustion, error propagation, and the
+round-5 ``stats`` hook (the in-session ingest measurement —
+tools/ingest_session_probe.py reads ``stats`` to separate the loader's
+critical path from consumer compute that shares the host core)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.data.prefetch import DevicePrefetcher
+from theanompi_tpu.parallel.mesh import data_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return data_mesh(8)
+
+
+def _batches(n, global_batch=16):
+    for i in range(n):
+        yield (np.full((global_batch, 4), i, np.float32),
+               np.arange(global_batch, dtype=np.int32))
+
+
+class TestDevicePrefetcher:
+    def test_yields_all_batches_sharded(self, mesh):
+        pf = DevicePrefetcher(_batches(5), mesh)
+        got = list(pf)
+        assert len(got) == 5
+        x0, y0 = got[0]
+        assert x0.shape == (16, 4) and y0.shape == (16,)
+        assert float(np.asarray(x0)[0, 0]) == 0.0
+        assert float(np.asarray(got[4][0])[0, 0]) == 4.0
+        # sharded over the data axis, not replicated
+        assert len(x0.sharding.device_set) == 8
+
+    def test_stats_account_batches_and_images(self, mesh):
+        pf = DevicePrefetcher(_batches(3), mesh)
+        list(pf)
+        assert pf.stats["batches"] == 3
+        assert pf.stats["images"] == 3 * 16
+        assert pf.stats["busy_s"] > 0.0
+
+    def test_error_propagates_to_consumer(self, mesh):
+        def bad():
+            yield from _batches(1)
+            raise RuntimeError("loader exploded")
+
+        pf = DevicePrefetcher(bad(), mesh)
+        it = iter(pf)
+        next(it)
+        with pytest.raises(RuntimeError, match="loader exploded"):
+            while True:
+                next(it)
+
+    def test_close_stops_early(self, mesh):
+        pf = DevicePrefetcher(_batches(100), mesh)
+        next(iter(pf))
+        pf.close()  # must not hang or raise

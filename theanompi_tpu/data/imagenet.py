@@ -295,23 +295,56 @@ class ImageNet_data(Dataset):
                       shuffle_rng: np.random.Generator | None
                       ) -> Iterator[Batch]:
         """Stream batches across shard files with read-ahead decode.
-        Leftover tail samples of each file carry into the next batch."""
+        Leftover tail samples of each file carry into the next batch.
 
-        buf_x: list[np.ndarray] = []
-        buf_y: list[np.ndarray] = []
+        Each batch is assembled with ONE fancy-index gather per
+        contributing shard, straight from the mmap — the only host
+        copy an image takes before ``device_put``.  (The round-5
+        in-session probe, tools/ingest_session_probe.py, found the
+        previous shape of this loop — materialize ``x[perm]`` for the
+        whole shard, then np.concatenate carried tails — cost ~3
+        memcpy passes per image and capped a one-core host at ~1.4k
+        img/s warm; the gather form is bit-identical in output: the
+        same per-shard permutation sliced in the same order.)"""
+
+        # pending: [x, y, perm, pos] — shard arrays (x usually a
+        # mmap), its draw order, and how much of it is consumed.
+        # (A reusable gather buffer was tried and rejected: on a
+        # single-device CPU mesh jax.device_put may zero-copy ALIAS
+        # host numpy memory, so reusing the buffer could corrupt an
+        # in-flight staged batch — and the isolated profile showed
+        # allocation is not the bottleneck.)
+        pending: list[list] = []
         buffered = 0
+
+        def assemble() -> Batch:
+            x0 = pending[0][0]
+            xb = np.empty((global_batch,) + x0.shape[1:], x0.dtype)
+            parts_y: list[np.ndarray] = []
+            need, at = global_batch, 0
+            while need:
+                x, y, perm, pos = pending[0]
+                take = min(need, len(perm) - pos)
+                sel = perm[pos:pos + take]
+                np.take(x, sel, axis=0, out=xb[at:at + take])
+                parts_y.append(y[sel])
+                at += take
+                need -= take
+                if pos + take == len(perm):
+                    pending.pop(0)
+                else:
+                    pending[0][3] = pos + take
+            yb = parts_y[0] if len(parts_y) == 1 \
+                else np.concatenate(parts_y)
+            return xb, yb
+
         for x, y in readahead(files, _load_shard, self.readahead_depth):
-            if shuffle_rng is not None:
-                p = shuffle_rng.permutation(len(y))
-                x, y = x[p], y[p]
-            buf_x.append(x)
-            buf_y.append(y)
+            perm = (shuffle_rng.permutation(len(y))
+                    if shuffle_rng is not None else np.arange(len(y)))
+            pending.append([x, y, perm, 0])
             buffered += len(y)
             while buffered >= global_batch:
-                x_all = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
-                y_all = np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]
-                xb, yb = x_all[:global_batch], y_all[:global_batch]
-                buf_x, buf_y = [x_all[global_batch:]], [y_all[global_batch:]]
+                xb, yb = assemble()
                 buffered -= global_batch
                 if aug_rng is not None:
                     xb = self._prep_train(xb, aug_rng)

@@ -138,7 +138,13 @@ class ModelConfig:
     #: reference's per-worker semantics.  Requires a shard_map step
     #: with a live 'data' axis — incompatible with fsdp_sharding
     #: (GSPMD jit has no named axes; compile_iter_fns rejects the
-    #: combination)
+    #: combination).  Honored only by models whose build_module()
+    #: threads ``_bn_axis()`` into their BN layers — today that is the
+    #: ResNet family (resnet50.py); ``layers.BatchNorm`` exposes the
+    #: same ``axis_name`` knob for new zoo models, but the builder
+    #: must pass ``self._bn_axis()`` itself (round-4 advisor).  Models
+    #: that declare ``uses_batchnorm`` warn at compile when the
+    #: per-shard batch is small and this is left False.
     sync_bn: bool = False
     #: rematerialize transformer blocks in the backward pass
     #: (jax.checkpoint): activations are recomputed instead of stored,
@@ -499,10 +505,27 @@ class TpuModel:
                 axes.append(a)
         return part, tuple(axes)
 
+    #: models whose network contains BatchNorm set this True so the
+    #: small-shard warning below can fire (only they are exposed to
+    #: the noisy-per-shard-stats failure)
+    uses_batchnorm: bool = False
+
     def compile_iter_fns(self, sync_type: str = "avg") -> None:
         """Build the jitted SPMD steps (the reference's Theano-function
         compile; ``sync_type`` 'avg' vs 'cdd' maps to exchange avg/sum)."""
         part, axes = self._batch_axes()
+        if (self.uses_batchnorm and not self.config.sync_bn
+                and self.batch_size < 16):
+            import warnings
+
+            warnings.warn(
+                f"{type(self).__name__}: per-shard batch "
+                f"{self.batch_size} with sync_bn=False — BatchNorm "
+                "running statistics from so few images are too noisy "
+                "to serve eval (observed as chance-level val error at "
+                "converged train loss, round-4 jpeg e2e).  Set "
+                "ModelConfig.sync_bn=True (cross-replica stats) or "
+                "raise batch_size.", stacklevel=2)
         if (self.config.steps_per_call > 1
                 and self.config.grad_accum_steps > 1):
             raise ValueError(

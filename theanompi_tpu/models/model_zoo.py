@@ -40,7 +40,9 @@ class ResNet152(ResNet101):
 class ResNet50_LargeBatch(ResNet50):
     """The modern large-batch TPU recipe over the same network: LARS +
     linear warmup + cosine decay (Goyal-style ramp, You-style layerwise
-    trust ratios), per-chip batch 256, bf16 compute, space-to-depth
+    trust ratios), per-chip batch 128 (measured optimum — the round-3
+    on-chip ladder ran b/chip {128,256} x k {1,4,8} and 256 lost at
+    every k; see default_config below), bf16 compute, space-to-depth
     stem.  The reference era scaled its SGD LR linearly with workers
     (SURVEY.md §2.7 scale_lr); this is the recipe that replaced it when
     global batches outgrew plain momentum."""

@@ -41,7 +41,14 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--attn", default=None,
+                    choices=("auto", "pallas", "xla"),
+                    help="force the attention impl (the r3 'fused' "
+                    "points exported THEANOMPI_TPU_ATTN_IMPL by hand; "
+                    "a flag makes the queue JSON self-contained)")
     args = ap.parse_args()
+    if args.attn:
+        os.environ["THEANOMPI_TPU_ATTN_IMPL"] = args.attn
 
     from theanompi_tpu.models.base import ModelConfig
     from theanompi_tpu.models.transformer import TransformerLM
@@ -93,6 +100,8 @@ def main() -> int:
             "seq_len": args.seq,
             "layers": args.layers, "d_model": args.d_model,
             "remat": args.remat, "dtype": args.dtype,
+            "attn": args.attn or os.environ.get(
+                "THEANOMPI_TPU_ATTN_IMPL", "auto"),
             "step_ms": round(dt / args.steps * 1e3, 2),
             "tflops_per_chip": round(tflops / len(devices), 2),
             "train_gflops_per_seq": round(

@@ -52,20 +52,38 @@ def build(batch: int, dtype: str, variant: str):
     mesh = data_mesh(len(devices), devices)
     global_batch = batch * len(devices)
 
+    uint8_feed = variant == "uint8"
+
     class ProbeResNet50(ResNet50):
         def build_data(self):
+            if uint8_feed:
+                # the FLAGSHIP staging (bench.py device-step leg): raw
+                # uint8 store images, crop/flip/normalize traced into
+                # the step (ops/augment.py).  The f32 'base' variant
+                # stages pre-normalized floats — its trace carries an
+                # input f32->bf16 convert + 38 MB copy the flagship
+                # step doesn't have (seen in the r3/r4 account), and
+                # misses the device augment slice the flagship does.
+                return ImageNet_data(crop=224, synthetic_n=global_batch,
+                                     synthetic_pool=1,
+                                     synthetic_store=256,
+                                     augment_on_device=True)
             return ImageNet_data(crop=224, synthetic_n=global_batch,
                                  synthetic_pool=1, synthetic_store=32)
 
     cfg = ModelConfig(batch_size=batch, compute_dtype=dtype,
                       track_top5=False, print_freq=10**9)
     model = ProbeResNet50(config=cfg, mesh=mesh, verbose=False)
-    if variant != "base":
+    if variant not in ("base", "uint8"):
         raise ValueError(variant)
     model.compile_iter_fns("avg")
 
-    x = np.random.default_rng(0).standard_normal(
-        (global_batch, 224, 224, 3)).astype(np.float32)
+    if uint8_feed:
+        x = np.random.default_rng(0).integers(
+            0, 256, size=(global_batch, 256, 256, 3), dtype=np.uint8)
+    else:
+        x = np.random.default_rng(0).standard_normal(
+            (global_batch, 224, 224, 3)).astype(np.float32)
     y = np.random.default_rng(1).integers(0, 1000, global_batch)
     staged = shard_batch((x, y), mesh)
     return model, staged, mesh, global_batch
@@ -79,7 +97,14 @@ def main():
     ap.add_argument("--variant", default="base")
     ap.add_argument("--trace", default=None,
                     help="dump a jax.profiler trace to this dir")
+    ap.add_argument("--xla-flags", default=None,
+                    help="appended to XLA_FLAGS before first backend use "
+                    "(round-5 queue: capture the profile under the "
+                    "scoped-VMEM flag that wins the sweep)")
     args = ap.parse_args()
+    if args.xla_flags:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " " + args.xla_flags)
 
     for b in args.batch:
         model, staged, mesh, global_batch = build(b, args.dtype, args.variant)

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Snapshot gate (round-4 verdict #6 / round-3 #4b): the FULL suite —
+# slow tests included — plus the driver entry points must be green
+# before any end-of-round snapshot.  Round 3 committed a slow e2e test
+# that had never been run (it failed); nothing structural prevented a
+# repeat until this script.
+#
+# Usage:  bash tools/preflight.sh [artifacts/preflight_rNN.log]
+# Exit 0 = safe to snapshot.  Writes the full output to the log path
+# (default artifacts/preflight.log) so the round log can cite it.
+set -u
+LOG="${1:-artifacts/preflight.log}"
+cd "$(dirname "$0")/.."
+{
+  echo "# preflight $(date -u +%Y-%m-%dT%H:%M:%SZ) HEAD=$(git rev-parse --short HEAD)"
+  echo "## pytest --runslow"
+  python -m pytest tests/ --runslow -q
+  PYTEST_RC=$?
+  echo "pytest rc=$PYTEST_RC"
+  echo "## __graft_entry__ (entry + dryrun_multichip on the virtual mesh)"
+  # CPU-forced: a wedged axon tunnel must not hang the gate (the
+  # driver compile-checks entry() on the real chip separately)
+  THEANOMPI_TPU_ENTRY_CPU=1 python __graft_entry__.py
+  ENTRY_RC=$?
+  echo "graft_entry rc=$ENTRY_RC"
+  if [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ]; then
+    echo "PREFLIGHT: FAIL"
+    exit 1
+  fi
+  echo "PREFLIGHT: GREEN"
+} 2>&1 | tee "$LOG"
+exit "${PIPESTATUS[0]}"
