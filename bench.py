@@ -80,6 +80,19 @@ PROBE_ATTEMPT_S = int(os.environ.get("THEANOMPI_TPU_BENCH_PROBE_ATTEMPT_S",
 HEARTBEAT_S = max(1.0, float(
     os.environ.get("THEANOMPI_TPU_BENCH_HEARTBEAT_S", "30")))
 
+# The newest committed on-chip measurement, embedded in every failure
+# record (VERDICT r4 #5: a wedged-tunnel round must still hand the
+# driver a machine-readable number).  The `date` field makes staleness
+# self-describing to consumers; UPDATE THIS (and BASELINE.md) when a
+# new on-chip point lands — tools/harvest_queue.py prints the ladder.
+LAST_VERIFIED_ON_CHIP = {
+    "value": 2622.04,
+    "unit": "images/sec/chip",
+    "date": "2026-08-02",
+    "source": "artifacts/tpu_queue_r03.jsonl (round-3 window, k=4 "
+              "b=128 conv7; last DRIVER-verified: 2595.58, BENCH_r01)",
+}
+
 # Live status for the failure envelope: updated by the probe loop and
 # the measurement legs, read by the SIGTERM/SIGINT handler so a killed
 # run still emits one parseable JSON line (round-3 verdict #1).
@@ -102,14 +115,7 @@ def _failure_json(reason: str) -> str:
                     "numbers: BASELINE.md 'Measured' table",
             # machine-readable pointer so a failure record still
             # carries the last driver-checkable number (VERDICT r4 #5)
-            "last_verified": {
-                "value": 2622.04,
-                "unit": "images/sec/chip",
-                "date": "2026-08-02",
-                "source": "artifacts/tpu_queue_r03.jsonl "
-                          "(round-3 window, k=4 b=128 conv7; "
-                          "last DRIVER-verified: 2595.58, BENCH_r01)",
-            },
+            "last_verified": LAST_VERIFIED_ON_CHIP,
         },
     })
 

@@ -212,7 +212,10 @@ class TestSyncBN:
         m.cleanup()
 
         with warnings.catch_warnings():
-            warnings.simplefilter("error")
+            # escalate only the guarded warning: a blanket 'error'
+            # would make this test fail on unrelated library
+            # deprecations inside the jit trace
+            warnings.filterwarnings("error", message=".*sync_bn.*")
             m = TinyRN(config=dataclasses.replace(cfg, sync_bn=True),
                        mesh=mesh8)
             m.compile_iter_fns("avg")

@@ -130,13 +130,23 @@ def _load_shard(path: str):
     (tools/host_pipeline_probe.py measures both formats).  ``.npz``
     (zip container, member copy per load) remains supported.
 
-    The strided touch forces every page in NOW: this function runs in
-    the read-ahead thread, so the disk I/O still overlaps training the
-    way the npz decode did — without it the mmap would defer all I/O
-    to page faults inside the consumer's gather."""
+    Cold-read strategy (round 5): ``posix_fadvise(WILLNEED)`` first —
+    the kernel then streams the whole file at device speed (measured
+    6 GB/s buffered on this box) instead of serving one page fault at
+    a time (the bare strided touch measured 0.365 GB/s cold: QD-1
+    faults, 16x under the device).  The strided touch AFTER the hint
+    still (a) forces residency so the consumer's gather never blocks
+    on I/O and (b) paces this read-ahead thread so ``readahead_depth``
+    bounds memory, but it now walks pages the fadvise already landed."""
     if path.endswith(".x.npy"):
         x = np.load(path, mmap_mode="r")
-        x.reshape(-1)[:: 4096].sum()  # one byte per page: prefetch
+        try:
+            with open(path, "rb") as fh:
+                os.posix_fadvise(fh.fileno(), 0, 0,
+                                 os.POSIX_FADV_WILLNEED)
+        except (AttributeError, OSError):  # pragma: no cover
+            pass  # non-POSIX or odd fs: fall back to fault-driven I/O
+        x.reshape(-1)[:: 4096].sum()  # one byte per page: residency
         return x, np.load(path[: -len(".x.npy")] + ".y.npy"
                           ).astype(np.int32)
     with np.load(path) as z:

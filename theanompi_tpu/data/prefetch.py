@@ -42,9 +42,14 @@ class DevicePrefetcher:
     _SENTINEL = object()
 
     def __init__(self, host_batches: Iterable, mesh, depth: int = 2,
-                 spec=None):
+                 spec=None, images_per_batch: int | None = None):
         self.mesh = mesh
         self.spec = spec  # PartitionSpec override (default: data axis)
+        # stacked cadences (steps_per_call / grad_accum) stage
+        # (k, global_batch, ...) leaves, where leaves[0].shape[0] is k,
+        # not an image count — callers that stack must say how many
+        # images one staged batch carries (models/base.py does)
+        self._images_per_batch = images_per_batch
         self.stats = {"busy_s": 0.0, "batches": 0, "images": 0}
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
@@ -68,9 +73,12 @@ class DevicePrefetcher:
                 s = self.stats
                 s["busy_s"] += time.perf_counter() - t0
                 s["batches"] += 1
-                leaves = jax.tree.leaves(staged)
-                if leaves:
-                    s["images"] += leaves[0].shape[0]
+                if self._images_per_batch is not None:
+                    s["images"] += self._images_per_batch
+                else:
+                    leaves = jax.tree.leaves(staged)
+                    if leaves:
+                        s["images"] += leaves[0].shape[0]
                 while not self._stop.is_set():
                     try:
                         self._q.put(staged, timeout=0.1)

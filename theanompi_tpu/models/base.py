@@ -670,8 +670,9 @@ class TpuModel:
                     f"NOTHING; shrink the stack or grow the dataset/"
                     f"batch ratio")
             spec = self.stacked_batch_spec()
-        self._train_prefetcher = DevicePrefetcher(host_iter, self.mesh,
-                                                  spec=spec)
+        self._train_prefetcher = DevicePrefetcher(
+            host_iter, self.mesh, spec=spec,
+            images_per_batch=self.global_batch * stack)
         self._train_iter = iter(self._train_prefetcher)
         return n_iters
 
