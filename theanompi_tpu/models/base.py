@@ -670,9 +670,13 @@ class TpuModel:
                     f"NOTHING; shrink the stack or grow the dataset/"
                     f"batch ratio")
             spec = self.stacked_batch_spec()
+        # per staged batch this PROCESS assembles: multi-host iterators
+        # yield only this host's slice of each global batch
+        host_rows = self.global_batch // (self.host_count
+                                          if self.multiprocess else 1)
         self._train_prefetcher = DevicePrefetcher(
             host_iter, self.mesh, spec=spec,
-            images_per_batch=self.global_batch * stack)
+            images_per_batch=host_rows * stack)
         self._train_iter = iter(self._train_prefetcher)
         return n_iters
 

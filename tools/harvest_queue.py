@@ -64,24 +64,35 @@ def main() -> int:
               file=sys.stderr)
 
     if rows:
-        print("| k | batch/chip | stem | img/s/chip | TF/s (2xMAC) "
-              "| dispatch ms | compile s |")
-        print("|---|---|---|---|---|---|---|")
+        print("| k | batch/chip | stem | xla flags | img/s/chip "
+              "| TF/s (2xMAC) | dispatch ms | compile s |")
+        print("|---|---|---|---|---|---|---|---|")
         for r in rows:
             tfs = r["img_per_sec_per_chip"] * TRAIN_GF_PER_IMG / 1e3
+            # the r5 queue sweeps --xla_tpu_scoped_vmem_limit_kib;
+            # show the flag so sweep rows are distinguishable from the
+            # default-flag ladder
+            flags = r.get("xla_flags", "") or "-"
+            flags = flags.replace("--xla_tpu_scoped_vmem_limit_kib=",
+                                  "vmem_kib=")
             print(f"| {r['steps_per_call']} | {r['batch_per_chip']} "
-                  f"| {r.get('stem', 'conv7')} "
+                  f"| {r.get('stem', 'conv7')} | {flags} "
                   f"| {r['img_per_sec_per_chip']} | {tfs:.1f} "
                   f"| {r['dispatch_ms']} | {r.get('compile_s', '?')} |")
         best = max(rows, key=lambda r: r["img_per_sec_per_chip"])
+        bflags = best.get("xla_flags", "") or ""
         print(f"\nwinner: k={best['steps_per_call']} "
-              f"b={best['batch_per_chip']} stem={best.get('stem', 'conv7')} "
-              f"-> {best['img_per_sec_per_chip']} img/s/chip")
+              f"b={best['batch_per_chip']} stem={best.get('stem', 'conv7')}"
+              + (f" xla_flags={bflags}" if bflags else "")
+              + f" -> {best['img_per_sec_per_chip']} img/s/chip")
         print("adopt in bench.py defaults: "
               f"THEANOMPI_TPU_BENCH_K={best['steps_per_call']} "
               f"THEANOMPI_TPU_BENCH_BATCH={best['batch_per_chip']}"
               + ("" if best.get("stem", "conv7") == "conv7"
-                 else "  (+ ModelConfig resnet_stem='s2d')"))
+                 else "  (+ ModelConfig resnet_stem='s2d')")
+              + ("" if not bflags
+                 else f"  (+ XLA_FLAGS+=' {bflags}' — a sweep row won; "
+                      "bench.py cannot reproduce it without the flag)"))
 
     for name, items in (("attention", attn), ("h2d", h2d),
                         ("conv ladder", ladder),
