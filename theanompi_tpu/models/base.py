@@ -129,6 +129,12 @@ class ModelConfig:
     #: (exact space-to-depth re-parameterization — the TPU-friendly
     #: shape for the C=3 stem conv; models/resnet50.py)
     resnet_stem: str = "conv7"
+    #: stem max-pool impl: 'xla' (reduce_window; select-and-scatter
+    #: backward) or 'pallas' (argmax-saving kernel with a gather
+    #: backward, ops/maxpool_pallas.py — predicted ~2x fewer backward
+    #: bytes from the MFU account; flip per-recipe only after
+    #: tools/bench_maxpool.py confirms on chip)
+    pool_impl: str = "xla"
     #: cross-replica BatchNorm: compute BN batch statistics over the
     #: whole DATA axis (lax.pmean inside the BN, flax ``axis_name``)
     #: instead of per-shard.  The standard TPU-pod choice when the

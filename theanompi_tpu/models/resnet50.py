@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from theanompi_tpu.data.imagenet import ImageNet_data
 from theanompi_tpu.models import layers as L
 from theanompi_tpu.models.base import ModelConfig, TpuModel
+from theanompi_tpu.ops.maxpool import maxpool_stem
 
 
 class BottleneckBlock(nn.Module):
@@ -104,6 +105,9 @@ class ResNet(nn.Module):
     stem: str = "conv7"          # 'conv7' | 's2d'
     #: cross-replica BN axis (ModelConfig.sync_bn); None = per-shard
     bn_axis: str | None = None
+    #: stem max-pool impl (ModelConfig.pool_impl): 'xla' or 'pallas'
+    #: (argmax-saving kernel, ops/maxpool_pallas.py)
+    pool_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -136,7 +140,7 @@ class ResNet(nn.Module):
         # HBM-bound loop fusion (artifacts/fusion_deepdive.json
         # 'fwd/ResNet/max'); post-pool it fuses into the maxpool
         # output fusion's quarter-size stream.
-        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        x = maxpool_stem(x, impl=self.pool_impl)
         x = nn.relu(x)
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
@@ -182,7 +186,8 @@ class ResNet50(TpuModel):
                       n_classes=self.data.n_classes,
                       dtype=self._compute_dtype(),
                       stem=self.config.resnet_stem,
-                      bn_axis=self._bn_axis())
+                      bn_axis=self._bn_axis(),
+                      pool_impl=self.config.pool_impl)
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir,

@@ -1,3 +1,4 @@
 from theanompi_tpu.ops.lrn import lrn
+from theanompi_tpu.ops.maxpool import maxpool_stem
 
-__all__ = ["lrn"]
+__all__ = ["lrn", "maxpool_stem"]

@@ -1,0 +1,44 @@
+"""Max-pool front-end: XLA ``reduce_window`` or the Pallas argmax
+kernel (ops/maxpool_pallas.py).
+
+Only the ResNet stem geometry (3x3, stride 2, pad 1, NHWC with even
+H/W) has a Pallas path — that is the one pool in the flagship model,
+and its backward (XLA select-and-scatter) is the account's only
+near-zero-FLOP slice with measured bandwidth headroom (0.761 ms/step
+at 74% of HBM peak; artifacts/fusion_deepdive.json).  Anything else
+routes to XLA.
+
+Default 'xla': unlike ops.lrn, the Pallas win here is PREDICTED from
+the account's byte counts (~282 vs ~460 MB for the bwd), not yet
+measured on silicon — tools/bench_maxpool.py is queued
+(artifacts/queue_r05_exps.json); flip the default only when the chip
+agrees.  Env override: ``THEANOMPI_TPU_POOL_IMPL``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from flax import linen as nn
+
+
+def maxpool_stem(x: jax.Array, impl: str | None = None) -> jax.Array:
+    """3x3/stride-2/pad-1 max pool (the ResNet stem pool).
+
+    ``impl``: 'xla' (default; reduce_window + select-and-scatter bwd)
+    or 'pallas' (argmax-saving kernel, gather backward).  The
+    ``THEANOMPI_TPU_POOL_IMPL`` env var takes precedence over the
+    argument so an operator can A/B the kernel on chip without
+    editing recipes (the model path always passes its config value,
+    which would otherwise shadow the env).
+    """
+    impl = os.environ.get("THEANOMPI_TPU_POOL_IMPL") or impl or "xla"
+    if impl == "pallas":
+        from theanompi_tpu.ops.maxpool_pallas import maxpool3x3s2
+
+        return maxpool3x3s2(x)
+    if impl != "xla":
+        raise ValueError(
+            f"unknown pool impl {impl!r} (want 'xla'|'pallas')")
+    return nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])

@@ -1,0 +1,171 @@
+"""Pallas TPU kernel for the ResNet stem max-pool (3x3/stride-2/pad-1),
+forward + argmax-based custom VJP.
+
+Why this exists: the round-4/5 op-level account of the real v5e step
+(artifacts/mfu_account.json, artifacts/fusion_deepdive.json) shows the
+ONE maxpool backward as XLA ``select-and-scatter`` costing
+0.761 ms/step at 608 GB/s = 74% of HBM peak — the only slice of the
+near-zero-FLOP time with real bandwidth headroom.  select-and-scatter
+re-reads the full input x (205 MB at b=128 bf16) to rediscover each
+window's argmax.  This kernel stores the argmax at forward time
+(int8, 1/8th of x) and computes the backward as a pure GATHER:
+
+    dx[i,j] = sum over the <=4 windows covering (i,j) of
+              g[w] * [idx[w] == tap of (i,j) in w]
+
+so the backward streams g + idx + writes dx ≈ 282 MB instead of
+~460 MB — a ~0.34 ms bound vs the measured 0.76.  The gather is
+expressed scatter-free by decomposing input pixels into (row, col)
+parity classes: for stride 2 each class receives from a fixed subset
+of the 9 taps at a fixed output offset, so each class is a sum of
+``where(idx_slice == tap, g_slice, 0)`` terms and the four class
+planes interleave back with stack+reshape.
+
+Tie semantics: FIRST maximum in row-major window order (strict ``>``
+during the tap scan), matching jnp.argmax; XLA's select-and-scatter
+also routes ties to one element, so gradient mass is conserved either
+way — tests pin equality on tie-free inputs and conservation always.
+
+Like ops/lrn_pallas.py this runs in interpret mode off-TPU, so the
+numerics are unit-tested on the CPU mesh; the on-chip win is measured
+by tools/bench_maxpool.py (queued).  Opt-in via
+``ModelConfig.pool_impl='pallas'`` — 'xla' stays the default until the
+chip confirms the account's prediction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, y_ref, idx_ref, *, oh, ow):
+    x = x_ref[0]                       # (H, W, C)
+    # -inf padding exactly like XLA's reduce_window init, so a window
+    # of true -inf inputs still yields -inf (a finite sentinel would
+    # mask an upstream overflow).  bidx initializes to tap 4 — the
+    # window CENTER, which is in-bounds for every window under pad-1 —
+    # so when nothing beats -inf (all-(-inf) window) the backward
+    # still routes that window's cotangent to a real pixel and
+    # gradient mass stays conserved.  Finite ties are unaffected: the
+    # first tap to exceed -inf claims the window, so first-max
+    # row-major order still holds.
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0)), constant_values=neg)
+    best = jnp.full((oh, ow, x.shape[-1]), neg, x.dtype)
+    bidx = jnp.full((oh, ow, x.shape[-1]), 4, jnp.int32)
+    for t in range(9):
+        dy, dx = divmod(t, 3)
+        v = jax.lax.slice(xp, (dy, dx, 0),
+                          (dy + 2 * oh - 1, dx + 2 * ow - 1,
+                           xp.shape[-1]), (2, 2, 1))
+        take = v > best                # strict: first max wins ties
+        best = jnp.where(take, v, best)
+        bidx = jnp.where(take, t, bidx)
+    y_ref[0] = best
+    idx_ref[0] = bidx.astype(jnp.int8)
+
+
+def _bwd_kernel(g_ref, idx_ref, dx_ref, *, oh, ow):
+    g = g_ref[0]                       # (OH, OW, C)
+    idx = idx_ref[0].astype(jnp.int32)
+    c = g.shape[-1]
+    # pad by one output cell on each side; padded idx = -1 never matches
+    gp = jnp.pad(g, ((1, 1), (1, 1), (0, 0)))
+    ip = jnp.pad(idx, ((1, 1), (1, 1), (0, 0)), constant_values=-1)
+
+    def class_plane(pi, pj):
+        acc = jnp.zeros((oh, ow, c), g.dtype)
+        for dy in range(3):
+            if (pi + 1 - dy) % 2:
+                continue
+            o = (pi + 1 - dy) // 2     # output row offset, 0 or 1
+            for dx in range(3):
+                if (pj + 1 - dx) % 2:
+                    continue
+                p = (pj + 1 - dx) // 2
+                gs = jax.lax.slice(gp, (o + 1, p + 1, 0),
+                                   (o + 1 + oh, p + 1 + ow, c))
+                is_ = jax.lax.slice(ip, (o + 1, p + 1, 0),
+                                    (o + 1 + oh, p + 1 + ow, c))
+                acc = acc + jnp.where(is_ == dy * 3 + dx, gs, 0)
+        return acc
+
+    ee, eo = class_plane(0, 0), class_plane(0, 1)
+    oe, oo = class_plane(1, 0), class_plane(1, 1)
+    # interleave columns within each row class, then rows
+    top = jnp.stack([ee, eo], axis=2).reshape(oh, 2 * ow, c)
+    bot = jnp.stack([oe, oo], axis=2).reshape(oh, 2 * ow, c)
+    dx_ref[0] = jnp.stack([top, bot], axis=1).reshape(2 * oh, 2 * ow, c)
+
+
+def _check(x):
+    if x.ndim != 4:
+        raise ValueError(f"maxpool3x3s2 expects NHWC, got {x.shape}")
+    b, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(
+            "maxpool3x3s2 (stride 2, pad 1) needs even H and W so the "
+            f"parity-interleaved backward tiles exactly; got {x.shape} "
+            "— use ops.maxpool default impl='xla' for odd sizes")
+    return b, h, w, c
+
+
+@jax.custom_vjp
+def maxpool3x3s2(x: jax.Array) -> jax.Array:
+    """3x3/stride-2/pad-1 max pool over NHWC via the Pallas kernel —
+    the ResNet stem pool geometry (models/resnet50.py)."""
+    y, _ = _mp_fwd(x)
+    return y
+
+
+def _mp_fwd(x):
+    b, h, w, c = _check(x)
+    oh, ow = h // 2, w // 2
+    kern = functools.partial(_fwd_kernel, oh=oh, ow=ow)
+    y, idx = pl.pallas_call(
+        kern,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, oh, ow, c), x.dtype),
+            jax.ShapeDtypeStruct((b, oh, ow, c), jnp.int8),
+        ],
+        interpret=_auto_interpret(),
+    )(x)
+    return y, idx
+
+
+def _mp_bwd(idx, g):
+    b, oh, ow, c = idx.shape
+    h, w = 2 * oh, 2 * ow
+    kern = functools.partial(_bwd_kernel, oh=oh, ow=ow)
+    spec_o = pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    dx = pl.pallas_call(
+        kern,
+        grid=(b,),
+        in_specs=[spec_o, spec_o],
+        out_specs=pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, c), g.dtype),
+        interpret=_auto_interpret(),
+    )(g, idx)
+    return (dx,)
+
+
+maxpool3x3s2.defvjp(_mp_fwd, _mp_bwd)
