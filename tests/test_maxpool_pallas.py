@@ -103,3 +103,18 @@ class TestMaxpoolPallas:
         for a, b in zip(jax.tree.leaves(gx), jax.tree.leaves(gp)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-5)
+
+    def test_nan_propagates_like_xla(self):
+        x = jax.random.normal(jax.random.key(5), (1, 8, 8, 8))
+        x = x.at[0, 3, 3, 2].set(jnp.nan)
+        ref = np.asarray(_xla(x))
+        got = np.asarray(maxpool3x3s2(x))
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(ref))
+        np.testing.assert_array_equal(got[~np.isnan(ref)],
+                                      ref[~np.isnan(ref)])
+        # and under grad (the argmax-saving fwd variant): nansum zeroes
+        # the NaN windows' cotangents, so the routed gradient must be
+        # finite everywhere — NaN windows route to the (one) NaN pixel
+        # with weight 0, never smearing NaN into neighbors
+        g = jax.grad(lambda x: jnp.nansum(maxpool3x3s2(x)))(x)
+        assert np.isfinite(np.asarray(g)).all()

@@ -134,3 +134,14 @@ def test_run_tpu_queue_requeue_and_forwarding(tmp_path):
     assert len(starts) == 7  # 3 + 2 requeues each for fail and hang
     assert [d["name"] for d in dones] == ["stub_ok"]
     assert recs[-1]["event"] == "queue_done"
+
+
+def test_bench_maxpool_smoke():
+    r = _run_tool([os.path.join(REPO_ROOT, "tools/bench_maxpool.py"),
+                   "2", "16", "8"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    recs = [json.loads(line) for line in r.stdout.splitlines() if line]
+    impls = [rec.get("impl") for rec in recs if "impl" in rec]
+    assert impls == ["xla", "pallas"]
+    assert all(rec["fwd_bwd_ms"] > 0 for rec in recs if "impl" in rec)
+    assert recs[-1]["event"] == "summary" and recs[-1]["speedup_pallas"] > 0

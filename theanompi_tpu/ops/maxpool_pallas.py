@@ -66,11 +66,33 @@ def _fwd_kernel(x_ref, y_ref, idx_ref, *, oh, ow):
         v = jax.lax.slice(xp, (dy, dx, 0),
                           (dy + 2 * oh - 1, dx + 2 * ow - 1,
                            xp.shape[-1]), (2, 2, 1))
-        take = v > best                # strict: first max wins ties
+        # strict >: first max wins ties.  NaN must PROPAGATE like
+        # reduce_window's max (NaN > x is false, so a bare scan would
+        # silently drop NaNs): the first NaN tap claims the window and
+        # sticks (isnan(best) blocks later takes).
+        take = ((v > best) | jnp.isnan(v)) & ~jnp.isnan(best)
         best = jnp.where(take, v, best)
         bidx = jnp.where(take, t, bidx)
     y_ref[0] = best
     idx_ref[0] = bidx.astype(jnp.int8)
+
+
+def _fwd_value_kernel(x_ref, y_ref, *, oh, ow):
+    """idx-free forward for the PRIMAL path: under plain inference/eval
+    (no grad), the two-output kernel would still write the int8 argmax
+    plane (~x/8 bytes of HBM) that XLA cannot dead-code-eliminate out
+    of an opaque pallas_call (round-5 review)."""
+    x = x_ref[0]
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0)), constant_values=neg)
+    best = jnp.full((oh, ow, x.shape[-1]), neg, x.dtype)
+    for t in range(9):
+        dy, dx = divmod(t, 3)
+        v = jax.lax.slice(xp, (dy, dx, 0),
+                          (dy + 2 * oh - 1, dx + 2 * ow - 1,
+                           xp.shape[-1]), (2, 2, 1))
+        best = jnp.where((v > best) | jnp.isnan(v), v, best)
+    y_ref[0] = best
 
 
 def _bwd_kernel(g_ref, idx_ref, dx_ref, *, oh, ow):
@@ -121,9 +143,23 @@ def _check(x):
 @jax.custom_vjp
 def maxpool3x3s2(x: jax.Array) -> jax.Array:
     """3x3/stride-2/pad-1 max pool over NHWC via the Pallas kernel —
-    the ResNet stem pool geometry (models/resnet50.py)."""
-    y, _ = _mp_fwd(x)
-    return y
+    the ResNet stem pool geometry (models/resnet50.py).
+
+    The primal body (inference/eval, no grad) runs the idx-free
+    kernel; under AD the custom_vjp fwd rule below replaces it with
+    the argmax-saving variant."""
+    b, h, w, c = _check(x)
+    oh, ow = h // 2, w // 2
+    return pl.pallas_call(
+        functools.partial(_fwd_value_kernel, oh=oh, ow=ow),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, oh, ow, c), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, oh, ow, c), x.dtype),
+        interpret=_auto_interpret(),
+    )(x)
 
 
 def _mp_fwd(x):

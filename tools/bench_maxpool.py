@@ -44,6 +44,12 @@ def bench(fn, x, n_iters=30):
 
 
 def main() -> int:
+    # the env var overrides maxpool_stem's impl argument BY DESIGN (the
+    # recipe A/B knob) — which would make this A/B bench measure one
+    # impl twice under two labels; drop it for the comparison
+    if os.environ.pop("THEANOMPI_TPU_POOL_IMPL", None):
+        print("# ignoring THEANOMPI_TPU_POOL_IMPL for the A/B bench",
+              file=sys.stderr)
     b = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     hw = int(sys.argv[2]) if len(sys.argv) > 2 else 112
     c = int(sys.argv[3]) if len(sys.argv) > 3 else 64
