@@ -28,6 +28,12 @@ import subprocess
 import sys
 import time
 
+# NOTE: this (via theanompi_tpu/__init__ -> compat) imports jax at
+# module scope — same as the module-level `import jax` further down,
+# so the probe path's wedge isolation still relies on the SUBPROCESS
+# probe (importing jax is safe; creating a backend is what hangs)
+from theanompi_tpu import monitor
+
 BASELINE_PER_CHIP = 2500.0 / 16.0  # north-star v5e-16 target, per chip
 E2E_STEPS = int(os.environ.get("THEANOMPI_TPU_BENCH_E2E_STEPS", "64"))
 BATCH_PER_CHIP = int(os.environ.get("THEANOMPI_TPU_BENCH_BATCH", "128"))
@@ -96,9 +102,29 @@ LAST_VERIFIED_ON_CHIP = {
 # Live status for the failure envelope: updated by the probe loop and
 # the measurement legs, read by the SIGTERM/SIGINT handler so a killed
 # run still emits one parseable JSON line (round-3 verdict #1).
+# ``timeline`` is the machine-readable probe/phase event log: a
+# device-init hang used to leave only a prose error string (r04 wedged
+# 240 s with zero structured signal); now every attempt start, hang
+# timeout, failure, and phase change lands here and rides the failure
+# JSON, keeping BENCH_*.json comparable across rounds.
 _STATUS = {"phase": "startup", "probe_attempts": 0, "last_error": "",
-           "t0": time.monotonic()}
+           "t0": time.monotonic(), "timeline": []}
 _CURRENT_SUB = None  # Popen of the in-flight probe, for cleanup on kill
+
+
+def _timeline(event: str, **fields) -> None:
+    """Append one event to the machine-readable probe/phase timeline
+    (bounded: a pathological retry loop must not bloat the record)."""
+    if len(_STATUS["timeline"]) < 200:
+        _STATUS["timeline"].append(
+            {"t": round(time.monotonic() - _STATUS["t0"], 1),
+             "event": event, **fields})
+
+
+def _set_phase(phase: str) -> None:
+    _STATUS["phase"] = phase
+    _timeline("phase", phase=phase)
+    monitor.progress(phase=phase)
 
 
 def _failure_json(reason: str) -> str:
@@ -111,6 +137,10 @@ def _failure_json(reason: str) -> str:
             "probe_attempts": _STATUS["probe_attempts"],
             "last_error": _STATUS["last_error"],
             "elapsed_s": round(time.monotonic() - _STATUS["t0"], 1),
+            # the partial probe timeline: attempt starts, per-attempt
+            # wait durations, failures, last phase — machine-comparable
+            # across rounds even when the run never measured anything
+            "probe_timeline": _STATUS["timeline"],
             "note": "no measurement taken — last verified on-chip "
                     "numbers: BASELINE.md 'Measured' table",
             # machine-readable pointer so a failure record still
@@ -243,6 +273,9 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
         _STATUS["probe_attempts"] = attempts
         _heartbeat(f"probe attempt {attempts} starting "
                    f"({remaining:.0f}s left in window)")
+        _timeline("probe_attempt_start", attempt=attempts,
+                  window_left_s=round(remaining, 1))
+        t_attempt = time.monotonic()
         rc, stdout, stderr, timed_out = _run_probe_sub(
             [sys.executable, "-c", code],
             timeout=min(PROBE_ATTEMPT_S, remaining))
@@ -253,10 +286,15 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
             last_err = (f"device init hung past {PROBE_ATTEMPT_S}s "
                         "(wedged tunnel?)")
             _STATUS["last_error"] = last_err
+            _timeline("probe_attempt_hang", attempt=attempts,
+                      waited_s=round(time.monotonic() - t_attempt, 1))
             time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
             continue
         out = stdout.strip().splitlines()
         if rc == 0 and out:
+            _timeline("backend_up", attempt=attempts,
+                      platform=out[-1],
+                      waited_s=round(time.monotonic() - t_attempt, 1))
             return out[-1], ""
         tail = "; ".join(stderr.strip().splitlines()[-3:])
         err = f"backend init failed (rc={rc}): {tail}"
@@ -269,13 +307,16 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
         # heuristics misclassify those transients and re-zero the
         # round's record, the exact failure this retry loop exists to
         # prevent.
+        last_err = err
+        _STATUS["last_error"] = last_err
+        _timeline("probe_attempt_failed", attempt=attempts, rc=rc,
+                  error=err[:200],
+                  waited_s=round(time.monotonic() - t_attempt, 1))
         deterministic = ("not in the list of known backends",
                          "Unknown backend",
                          "ModuleNotFoundError", "ImportError")
         if any(s in err for s in deterministic):
             return None, f"{err} — not retrying (misconfig, not a wedge)"
-        last_err = err
-        _STATUS["last_error"] = last_err
         _heartbeat(f"probe attempt {attempts} failed: {err[:120]}")
         # back off, but never sleep away the final attempt's window —
         # the post-UNAVAILABLE recovery attempt is the whole point
@@ -294,18 +335,28 @@ def fenced_loss(metrics) -> float:
 
 
 def main() -> int:
+    # telemetry session (no-op unless $THEANOMPI_TPU_MONITOR is set):
+    # probe phases become spans and the heartbeat file names the live
+    # phase, so a hung bench self-diagnoses from outside instead of
+    # wedging silently (the r04 blind spot)
+    with monitor.session():
+        return _main()
+
+
+def _main() -> int:
     _install_kill_handler()
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         platform, err = "cpu", ""  # no tunnel involved; probe is moot
     else:
-        _STATUS["phase"] = "probe"
-        platform, err = _probe_backend()
+        _set_phase("probe")
+        with monitor.span("bench/probe"):
+            platform, err = _probe_backend()
     if platform is None:
         print(_failure_json(f"no measurement taken — {err}"), flush=True)
         return 1
-    _STATUS["phase"] = f"measure ({platform})"
+    _set_phase(f"measure ({platform})")
     _heartbeat(f"backend up: {platform}; building model")
 
     from theanompi_tpu.models.base import ModelConfig
@@ -353,20 +404,22 @@ def main() -> int:
 
     rng = jax.random.key(0)
     state = model.state
-    _STATUS["phase"] = "compile+warmup"
+    _set_phase("compile+warmup")
     _heartbeat("compiling the training step (first compile ~20-40s)")
-    for i in range(3):  # warmup: compile + steady state
-        state, metrics = step_fn(state, staged[i % len(staged)], rng)
-    fenced_loss(metrics)
+    with monitor.span("bench/compile_warmup"):
+        for i in range(3):  # warmup: compile + steady state
+            state, metrics = step_fn(state, staged[i % len(staged)], rng)
+        fenced_loss(metrics)
 
-    _STATUS["phase"] = "device-step leg"
+    _set_phase("device-step leg")
     _heartbeat("warm; timing the device-step leg")
     n_steps = max(1, N_STEPS // k)  # dispatches; each covers k iters
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        state, metrics = step_fn(state, staged[i % len(staged)], rng)
-    loss = fenced_loss(metrics)  # fences the whole chain
-    dt = time.perf_counter() - t0
+    with monitor.span("bench/device_step"):
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, metrics = step_fn(state, staged[i % len(staged)], rng)
+        loss = fenced_loss(metrics)  # fences the whole chain
+        dt = time.perf_counter() - t0
     assert np.isfinite(loss), f"non-finite loss {loss}"
     model.state = state  # keep the warm state for the e2e leg
 
@@ -379,7 +432,7 @@ def main() -> int:
     # TPU VM), which caps the e2e leg far below the device step; the
     # explicit ceiling keeps the e2e fraction honest instead of
     # looking like a pipeline bug.
-    _STATUS["phase"] = "h2d probe"
+    _set_phase("h2d probe")
     probe = next(model.data.train_batches(0, global_batch))
     probe_bytes = sum(np.asarray(a).nbytes for a in jax.tree.leaves(probe))
 
@@ -414,17 +467,18 @@ def main() -> int:
     # ---- leg 2: end-to-end through the real pipeline ----
     # train_iter covers k iterations per dispatch when steps_per_call
     # is on, so drive by consumed count like rules/bsp.py does
-    _STATUS["phase"] = "e2e leg"
+    _set_phase("e2e leg")
     _heartbeat(f"device step {step_per_chip:.0f} img/s/chip; e2e leg")
     recorder = Recorder(rank=0, size=n_chips, print_freq=0)
     n_iters = min(model.begin_epoch(0), E2E_STEPS)
     n_iters -= n_iters % k
-    t0 = time.perf_counter()
-    it = 0
-    while it < n_iters:
-        it += model.train_iter(it, recorder)
-    model._flush_metrics(recorder)  # device_fence on the last metrics
-    e2e_dt = time.perf_counter() - t0
+    with monitor.span("bench/e2e"):
+        t0 = time.perf_counter()
+        it = 0
+        while it < n_iters:
+            it += model.train_iter(it, recorder)
+        model._flush_metrics(recorder)  # device_fence on the last metrics
+        e2e_dt = time.perf_counter() - t0
     model.cleanup()
     assert np.isfinite(recorder.train_losses).all()
 

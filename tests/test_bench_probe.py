@@ -146,6 +146,37 @@ def test_probe_hang_then_recovery_is_caught(monkeypatch):
     assert len(calls) == 3
 
 
+def test_probe_timeline_lands_in_failure_json(monkeypatch):
+    """A device-init hang must leave a machine-readable probe timeline
+    (attempt starts, per-attempt wait durations, last phase) in the
+    failure JSON's detail — not just a prose error string."""
+    import json
+
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__(0, clock[0] + s))
+    monkeypatch.setitem(bench._STATUS, "timeline", [])
+    monkeypatch.setitem(bench._STATUS, "t0", 0.0)
+
+    def fake(argv, timeout):
+        clock[0] += timeout
+        return _HANG
+
+    monkeypatch.setattr(bench, "_run_probe_sub", fake)
+    platform, err = bench._probe_backend(window_s=700)
+    assert platform is None
+    detail = json.loads(bench._failure_json(err))["detail"]
+    tl = detail["probe_timeline"]
+    starts = [e for e in tl if e["event"] == "probe_attempt_start"]
+    hangs = [e for e in tl if e["event"] == "probe_attempt_hang"]
+    assert len(starts) >= 3 and len(hangs) >= 3
+    assert starts[0]["attempt"] == 1
+    assert all(h["waited_s"] <= bench.PROBE_ATTEMPT_S for h in hangs)
+    # every event is JSON-scalar (machine-comparable across rounds)
+    assert all(isinstance(e["t"], (int, float)) for e in tl)
+
+
 FAKE_JAX = '''\
 """Fake jax for bench envelope tests: imports fine, device init hangs
 forever — the observable signature of a wedged axon tunnel."""

@@ -80,6 +80,37 @@ def test_data_mesh_overrequest_raises(devices8):
         data_mesh(1024)
 
 
+def test_recorder_load_restores_all_time(tmp_path):
+    # a resumed run must report honest LIFETIME section totals: load()
+    # reconstructs all_time from the saved per-epoch time dicts
+    from theanompi_tpu.utils.recorder import Recorder
+
+    r = Recorder(rank=0, size=1, print_freq=0)
+    for epoch in range(2):
+        r.start()
+        r._t0 -= 1.5  # pretend 1.5s of calc
+        r.end("calc")
+        r.start()
+        r._t0 -= 0.25
+        r.end("wait")
+        r.train_metrics(1.0, 0.5, 8)
+        r.epoch_summary(epoch)
+    r.save(str(tmp_path))
+    expect_calc = r.all_time["calc"]
+
+    fresh = Recorder(rank=0, size=1, print_freq=0)
+    fresh.load(str(tmp_path))
+    assert fresh.epoch == 2
+    assert fresh.all_time["calc"] == pytest.approx(expect_calc, abs=0.01)
+    assert fresh.all_time["wait"] == pytest.approx(0.5, abs=0.01)
+    # and keeps accumulating on top of the restored totals
+    fresh.start()
+    fresh._t0 -= 2.0
+    fresh.end("calc")
+    assert fresh.all_time["calc"] == pytest.approx(expect_calc + 2.0,
+                                                   abs=0.01)
+
+
 def test_recorder_reports_tflops_when_model_declares_flops():
     from theanompi_tpu.utils.recorder import Recorder
 

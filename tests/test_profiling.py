@@ -60,6 +60,32 @@ def test_show_record_tool(tmp_path):
     assert "4" in out.stdout  # last epoch row present
 
 
+def test_step_profiler_context_manager_flushes_on_crash(tmp_path,
+                                                        monkeypatch):
+    # a crash mid-capture must still stop the trace (stop_trace is what
+    # flushes the files) — the context manager guarantees it
+    from theanompi_tpu.utils.profiling import StepProfiler
+
+    calls = []
+    monkeypatch.setattr("jax.profiler.start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr("jax.profiler.stop_trace",
+                        lambda: calls.append(("stop",)))
+    try:
+        with StepProfiler(str(tmp_path), n_steps=100) as p:
+            p.step()
+            raise RuntimeError("mid-capture crash")
+    except RuntimeError:
+        pass
+    assert calls == [("start", str(tmp_path)), ("stop",)]
+
+    # and a no-dir profiler stays a no-op as a context manager too
+    monkeypatch.delenv("THEANOMPI_TPU_PROFILE", raising=False)
+    with StepProfiler() as p:
+        p.step()
+    assert not any(c[0] == "start" for c in calls[2:])
+
+
 def test_step_profiler_spans_epochs(tmp_path, monkeypatch):
     # n_steps larger than one epoch: the trace must keep running into
     # the next epoch instead of silently truncating at the boundary

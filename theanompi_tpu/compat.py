@@ -1,0 +1,59 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern ``jax.shard_map`` entry point; on
+older jax (< 0.5, e.g. the 0.4.x line this image ships) the same
+function lives at ``jax.experimental.shard_map.shard_map`` with an
+identical call signature for the subset used here (``f, mesh,
+in_specs, out_specs``).  Importing this module (done by the package
+``__init__``) installs the alias once, so every ``jax.shard_map`` call
+site works on both lines without per-module guards.
+"""
+
+from __future__ import annotations
+
+import jax
+
+def _accepts_check_vma(fn) -> bool:
+    import inspect
+
+    try:
+        return "check_vma" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # C callable / no signature
+        return True  # assume modern; the wrapper would be a no-op
+
+
+_resolved = getattr(jax, "shard_map", None)
+if _resolved is None:
+    try:
+        from jax.experimental.shard_map import shard_map as _resolved
+    except ImportError:  # pragma: no cover - very old jax; leave as-is
+        _resolved = None
+
+if _resolved is not None and not _accepts_check_vma(_resolved):
+    import functools
+
+    _inner = _resolved
+
+    @functools.wraps(_inner)
+    def _compat_shard_map(*args, **kwargs):
+        # the replication-check kwarg was renamed check_rep ->
+        # check_vma when shard_map graduated; accept the new name on
+        # any line that still spells it check_rep (whether shard_map
+        # lives at jax.shard_map or jax.experimental.shard_map)
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _inner(*args, **kwargs)
+
+    _resolved = _compat_shard_map
+
+if _resolved is not None and getattr(jax, "shard_map", None) is not _resolved:
+    jax.shard_map = _resolved
+
+
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        # pre-axis_size idiom: the size of a named axis is the psum of
+        # 1 over it (constant-folded by XLA inside shard_map bodies)
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size

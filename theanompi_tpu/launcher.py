@@ -101,6 +101,13 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="write the session result (val metrics + scalar "
                         "rule stats, e.g. GOSGD gossip weights, EASGD "
                         "n_exchanges) as JSON — param trees are omitted")
+    p.add_argument("--monitor-dir", default=None, metavar="DIR",
+                   help="enable the telemetry subsystem and write its "
+                        "artifacts (metrics snapshot JSONL + Prometheus "
+                        "dump, per-rank heartbeat, crash postmortem) "
+                        "under DIR; equivalent to setting "
+                        "THEANOMPI_TPU_MONITOR=DIR "
+                        "(docs/OBSERVABILITY.md)")
     if multihost:
         p.add_argument("--coordinator", required=True,
                        help="host:port of host 0 (jax.distributed)")
@@ -163,6 +170,13 @@ def _resolve_model(args) -> tuple[str, str]:
 
 
 def _run(args, multihost: bool) -> int:
+    if args.monitor_dir:
+        # the env var is THE activation channel: the rule session, the
+        # recorder, the service clients, and any subprocess this run
+        # spawns all read it (theanompi_tpu/monitor)
+        import os
+
+        os.environ["THEANOMPI_TPU_MONITOR"] = args.monitor_dir
     if args.platform:
         import jax
 

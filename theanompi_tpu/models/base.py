@@ -806,10 +806,16 @@ class TpuModel:
                 self.global_batch, self.host_rank, self.host_count)
         else:
             host_iter = self.data.val_batches(self.global_batch)
+        from theanompi_tpu import monitor
+
         with DevicePrefetcher(host_iter, self.mesh,
                               spec=self.batch_partition) as pf:
             for n, batch in enumerate(pf):
                 pending.append(self.val_iter(n, recorder, batch))
+                # per-batch heartbeat: a long val epoch is progress,
+                # not a stall — only a WEDGED one should trip the
+                # watchdog
+                monitor.progress(phase="validate", step=n)
                 if (n + 1) % self.VAL_SYNC_WINDOW == 0:
                     recorder.start()
                     recorder.end("calc", block_on=pending[-1])

@@ -1,0 +1,326 @@
+"""theanompi_tpu.monitor — unified telemetry for rules, service,
+launchers, and bench.
+
+One process-wide monitor with four faces (docs/OBSERVABILITY.md is the
+operator's reference):
+
+* **metrics registry** (``registry.py``) — counters / gauges /
+  streaming histograms with labels, snapshot to JSONL + Prometheus
+  text;
+* **span tracing** (``spans.py``) — nested wall-clock spans that fence
+  on device arrays and emit ``jax.profiler.TraceAnnotation`` markers;
+* **health** (``health.py``) — heartbeat file + stall watchdog +
+  straggler detection;
+* **postmortem** (``postmortem.py``) — crash dump of the registry,
+  open spans, and recent step timings.
+
+Enablement contract (the part every call site relies on): monitoring
+is OFF unless a run dir is configured — either ``monitor.session(
+run_dir=...)`` from a rule/launcher, or the ``THEANOMPI_TPU_MONITOR``
+env var pointing at a directory.  When off, every facade function
+returns after ONE boolean check and the registry receives **zero
+writes** (tested: ``tests/test_monitor.py::test_disabled_is_noop``);
+instrumented hot loops pay one branch per call.
+
+Typical wiring (this is what rules/bsp.py does):
+
+    from theanompi_tpu import monitor
+
+    with monitor.session(run_dir=args.monitor_dir, rank=host):
+        with monitor.span("epoch", epoch=str(e)):
+            t0 = time.monotonic()
+            model.train_iter(it, recorder)
+            monitor.observe_step(time.monotonic() - t0,
+                                 phase="train", step=it)
+
+Files written under the run dir (rank-suffixed so multi-host runs on a
+shared filesystem never collide):
+
+    metrics_rank{r}.jsonl    latest registry snapshot, 1 series/line
+    metrics_rank{r}.prom     Prometheus text dump (final flush)
+    heartbeat_rank{r}.json   liveness + phase + progress age
+    postmortem_rank{r}.json  on unhandled rule-loop exceptions
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Iterator
+
+from theanompi_tpu.monitor.health import HeartbeatReporter, StragglerDetector
+from theanompi_tpu.monitor.postmortem import (
+    build_postmortem,
+    dump_postmortem as _dump_postmortem_file,
+)
+from theanompi_tpu.monitor.registry import (
+    MetricsRegistry,
+    tree_bytes,
+    tree_dtypes,
+)
+from theanompi_tpu.monitor.spans import NULL_SPAN, Span, open_spans
+
+ENV_VAR = "THEANOMPI_TPU_MONITOR"
+
+#: how many recent step durations the postmortem report carries
+RECENT_STEPS = 64
+
+__all__ = [
+    "ENV_VAR", "MetricsRegistry", "Span", "StragglerDetector",
+    "HeartbeatReporter", "enabled", "monitor_dir", "registry", "session",
+    "inc", "set_gauge", "add_gauge", "observe", "span", "progress",
+    "observe_step", "flush", "dump_postmortem", "open_spans",
+    "tree_bytes", "tree_dtypes", "reset_for_tests", "snapshot_path",
+]
+
+
+class _State:
+    """All mutable module state in one bag, swap-able for tests."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.enabled = False
+        self.run_dir: str | None = None
+        self.rank = 0
+        #: file-name discriminator: ``rank{r}`` for training ranks, a
+        #: caller-chosen name for co-located non-rank processes (a
+        #: tmserver beside a trainer must not clobber rank0's files)
+        self.suffix = "rank0"
+        self.heartbeat: HeartbeatReporter | None = None
+        self.straggler: StragglerDetector | None = None
+        self.recent_steps: deque[float] = deque(maxlen=RECENT_STEPS)
+        self.depth = 0
+
+
+_state = _State()
+_lock = threading.RLock()
+
+
+# ---------------------------------------------------------------------------
+# Introspection
+# ---------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def monitor_dir() -> str | None:
+    return _state.run_dir
+
+
+def registry() -> MetricsRegistry:
+    """The process registry.  Always exists (so its ``write_count``
+    can prove the disabled no-op path); only the facade writes to it
+    when enabled."""
+    return _state.registry
+
+
+def snapshot_path() -> str | None:
+    if _state.run_dir is None:
+        return None
+    return os.path.join(_state.run_dir,
+                        f"metrics_{_state.suffix}.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# Session lifecycle
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def session(run_dir: str | None = None, rank: int = 0,
+            interval: float | None = None,
+            stall_after: float | None = None,
+            name: str | None = None) -> Iterator[bool]:
+    """Activate monitoring for the enclosed block; yields whether it
+    is live.  ``run_dir=None`` falls back to ``$THEANOMPI_TPU_MONITOR``;
+    with neither set the block runs with monitoring fully disabled (the
+    strict no-op path).  Reentrant: nested sessions share the outer
+    one's registry/heartbeat and only the outermost exit flushes and
+    tears down.  An exception escaping the block triggers the
+    postmortem dump before re-raising."""
+    resolved = run_dir or os.environ.get(ENV_VAR) or None
+    if not resolved:
+        yield False
+        return
+    with _lock:
+        # activate BEFORE counting the depth: if activation raises
+        # (bad interval env value, unwritable dir) the count must not
+        # leak, or every later session would believe an outer one is
+        # live and silently record nothing
+        if _state.depth == 0:
+            _activate(resolved, rank, interval, stall_after, name)
+        _state.depth += 1
+    try:
+        yield True
+    except BaseException as e:
+        dump_postmortem(e)
+        raise
+    finally:
+        with _lock:
+            _state.depth -= 1
+            if _state.depth == 0:
+                _finalize()
+
+
+def _activate(run_dir: str, rank: int, interval: float | None,
+              stall_after: float | None,
+              name: str | None = None) -> None:
+    os.makedirs(run_dir, exist_ok=True)
+    # fresh registry per session: consecutive sessions in one process
+    # (a sweep, a notebook) must not merge each other's series into
+    # their snapshot files
+    _state.registry = MetricsRegistry()
+    _state.recent_steps.clear()
+    _state.run_dir = run_dir
+    _state.rank = rank
+    _state.suffix = name or f"rank{rank}"
+    _state.straggler = StragglerDetector(registry=_state.registry)
+    if interval is None:
+        interval = float(os.environ.get(
+            "THEANOMPI_TPU_MONITOR_INTERVAL", "5"))
+    if stall_after is None:
+        stall_after = float(os.environ.get(
+            "THEANOMPI_TPU_MONITOR_STALL_S", "60"))
+    _state.heartbeat = HeartbeatReporter(
+        run_dir, rank=rank, registry=_state.registry,
+        interval=interval, stall_after=stall_after,
+        snapshot_path=os.path.join(run_dir,
+                                   f"metrics_{_state.suffix}.jsonl"),
+        suffix=_state.suffix,
+    ).start()
+    _state.registry.set_gauge("monitor/enabled", 1.0)
+    _state.enabled = True
+
+
+def _finalize() -> None:
+    _state.enabled = False
+    # the final snapshot must say the session ENDED, and a later
+    # session's postmortem must not inherit this one's step timings
+    _state.registry.set_gauge("monitor/enabled", 0.0)
+    _state.recent_steps.clear()
+    hb, _state.heartbeat = _state.heartbeat, None
+    if hb is not None:
+        hb.stop()
+    run_dir, suffix = _state.run_dir, _state.suffix
+    if run_dir is not None:
+        try:
+            _state.registry.write_jsonl(
+                os.path.join(run_dir, f"metrics_{suffix}.jsonl"))
+            with open(os.path.join(run_dir,
+                                   f"metrics_{suffix}.prom"), "w") as f:
+                f.write(_state.registry.to_prometheus())
+        except OSError:
+            pass
+    _state.run_dir = None
+    _state.straggler = None
+
+
+def reset_for_tests() -> None:
+    """Hard reset: stop any heartbeat thread and swap in a fresh
+    state/registry.  Test fixture use only."""
+    global _state
+    with _lock:
+        hb = _state.heartbeat
+        if hb is not None:
+            hb.stop()
+        _state = _State()
+
+
+# ---------------------------------------------------------------------------
+# Hot-path instrumentation (all strictly gated)
+# ---------------------------------------------------------------------------
+
+
+def inc(name: str, amount: float = 1.0, /, **labels) -> None:
+    if not _state.enabled:
+        return
+    _state.registry.inc(name, amount, **labels)
+
+
+def set_gauge(name: str, value: float, /, **labels) -> None:
+    if not _state.enabled:
+        return
+    _state.registry.set_gauge(name, value, **labels)
+
+
+def add_gauge(name: str, delta: float, /, **labels) -> None:
+    if not _state.enabled:
+        return
+    _state.registry.add_gauge(name, delta, **labels)
+
+
+def observe(name: str, value: float, /, **labels) -> None:
+    if not _state.enabled:
+        return
+    _state.registry.observe(name, value, **labels)
+
+
+def span(name: str, /, fence: Any = None, **labels):
+    """A context manager timing the block into ``span_ms{name=...}``;
+    the shared no-op when monitoring is disabled.  ``fence=`` blocks on
+    a device array/pytree at exit so device time is charged to this
+    span (see spans.py)."""
+    if not _state.enabled:
+        return NULL_SPAN
+    return Span(name, registry=_state.registry, fence=fence, **labels)
+
+
+def progress(phase: str | None = None, step: int | None = None,
+             worker: int | None = None) -> None:
+    """Feed the heartbeat/watchdog: call whenever work advances."""
+    if not _state.enabled:
+        return
+    hb = _state.heartbeat
+    if hb is not None:
+        hb.progress(phase, step, worker)
+
+
+def observe_step(seconds: float, phase: str | None = None,
+                 step: int | None = None,
+                 worker: int | None = None) -> bool:
+    """One training-step observation: feeds the ``step_ms`` histogram,
+    the heartbeat, the postmortem's recent-step ring, and (when
+    ``worker`` is given — async rules) the straggler detector.
+    Returns True while the worker is flagged as a straggler."""
+    if not _state.enabled:
+        return False
+    _state.registry.observe(
+        "step_ms", seconds * 1e3,
+        worker=str(worker) if worker is not None else "0")
+    _state.recent_steps.append(seconds)
+    hb = _state.heartbeat
+    if hb is not None:
+        hb.progress(phase, step, worker)
+    if worker is not None and _state.straggler is not None:
+        return _state.straggler.observe(worker, seconds)
+    return False
+
+
+def flush() -> str | None:
+    """Write the snapshot JSONL now (also happens periodically from
+    the heartbeat thread and at session exit)."""
+    if not _state.enabled or _state.run_dir is None:
+        return None
+    path = snapshot_path()
+    try:
+        _state.registry.write_jsonl(path)
+    except OSError:
+        return None
+    return path
+
+
+def dump_postmortem(exc: BaseException | None = None) -> str | None:
+    """Write the crash report to the run dir; no-op when disabled.
+    Called automatically when an exception escapes ``session()``."""
+    if not _state.enabled or _state.run_dir is None:
+        return None
+    return _dump_postmortem_file(
+        _state.run_dir, _state.rank, exc,
+        registry=_state.registry,
+        recent_steps=list(_state.recent_steps),
+        suffix=_state.suffix)

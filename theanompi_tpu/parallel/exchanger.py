@@ -37,6 +37,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from theanompi_tpu import monitor
 from theanompi_tpu.parallel.mesh import AXIS_DATA
 
 PyTree = Any
@@ -116,6 +117,27 @@ class BSP_Exchanger:
     def exchange(self, tree: PyTree) -> PyTree:
         """Allreduce a pytree over the data axis. Traced into the step."""
         axis = self.axis
+
+        # Telemetry: this body executes at TRACE time (the exchange is
+        # compiled into the step), so per-call counting is impossible
+        # from here — what IS knowable here, exactly once per compile,
+        # is the exchange's shape: bytes moved per call and the wire
+        # dtype.  Per-step totals = bytes_per_call x the step counter.
+        if monitor.enabled():
+            if self.resolved == "psum_bf16":
+                # the compressed strategy ships 2 bytes/element
+                # regardless of the storage dtype
+                wire_dtype = "bfloat16"
+                nbytes = 2 * sum(
+                    int(getattr(l, "size", 0))
+                    for l in jax.tree.leaves(tree))
+            else:
+                wire_dtype = monitor.tree_dtypes(tree)
+                nbytes = monitor.tree_bytes(tree)
+            monitor.set_gauge("exchange/bytes_per_call", nbytes,
+                              strategy=self.resolved, dtype=wire_dtype,
+                              what=self.exchange_what)
+            monitor.inc("exchange/traces_total", strategy=self.resolved)
 
         if self.resolved == "psum_bf16":
             def reduce_leaf(x):

@@ -36,11 +36,14 @@ from __future__ import annotations
 import argparse
 import os
 import threading
+import time
 from multiprocessing.connection import Client, Connection, Listener
 from typing import Any
 
 import jax
 import numpy as np
+
+from theanompi_tpu import monitor
 
 PyTree = Any
 
@@ -240,31 +243,60 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
         ready_event.set()
 
     def handle_conn(conn: Connection):
-        with conn:
-            while True:
-                try:
-                    msg = conn.recv()
-                except (EOFError, OSError):
-                    return
-                if not isinstance(msg, tuple) or not msg:
-                    conn.send(("err", "malformed request"))
-                    continue
-                op, *args = msg
-                if op == "shutdown":
-                    conn.send(("ok", None))
-                    if stop_event is not None:
-                        stop_event.set()
-                    # unblock accept() so the serve loop exits
+        # connected-client gauge: one handler thread per connection, so
+        # inc/dec here IS the live connection count
+        monitor.add_gauge("service/clients", 1.0)
+        try:
+            with conn:
+                while True:
                     try:
-                        Client((host if host != "0.0.0.0" else "127.0.0.1",
-                                port), authkey=authkey).close()
-                    except OSError:
-                        pass
-                    return
-                try:
-                    conn.send(("ok", service.handle(op, *args)))
-                except Exception as e:  # surfaced client-side
-                    conn.send(("err", f"{type(e).__name__}: {e}"))
+                        msg = conn.recv()
+                    except (EOFError, OSError):
+                        return
+                    if not isinstance(msg, tuple) or not msg:
+                        monitor.inc("service/errors_total", op="malformed")
+                        conn.send(("err", "malformed request"))
+                        continue
+                    op, *args = msg
+                    if op == "shutdown":
+                        conn.send(("ok", None))
+                        if stop_event is not None:
+                            stop_event.set()
+                        # unblock accept() so the serve loop exits
+                        try:
+                            Client((host if host != "0.0.0.0"
+                                    else "127.0.0.1",
+                                    port), authkey=authkey).close()
+                        except OSError:
+                            pass
+                        return
+                    t0 = time.monotonic()
+                    try:
+                        result = service.handle(op, *args)
+                    except Exception as e:  # surfaced client-side
+                        monitor.inc("service/errors_total", op=op)
+                        conn.send(("err", f"{type(e).__name__}: {e}"))
+                        continue
+                    try:
+                        conn.send(("ok", result))
+                    except (EOFError, OSError):
+                        return  # peer gone; nothing to tell it
+                    except Exception as e:
+                        # reply failed to SERIALIZE (send pickles before
+                        # writing, so no bytes hit the wire yet) — the
+                        # client must still get a diagnostic, not a bare
+                        # EOFError
+                        monitor.inc("service/errors_total", op=op)
+                        conn.send(("err", f"{type(e).__name__}: {e}"))
+                        continue
+                    monitor.inc("service/requests_total", op=op)
+                    monitor.observe("service/rpc_ms",
+                                    (time.monotonic() - t0) * 1e3,
+                                    op=op)
+                    # served work IS this process's progress
+                    monitor.progress(phase="serving")
+        finally:
+            monitor.add_gauge("service/clients", -1.0)
 
     from multiprocessing import AuthenticationError
 
@@ -301,11 +333,26 @@ class ServiceClient:
         self._lock = threading.Lock()
 
     def call(self, op: str, *args):
+        # byte/latency accounting only when telemetry is live: the
+        # tree walk is cheap but not free, and the disabled path must
+        # stay a pure transport
+        mon = monitor.enabled()
+        if mon:
+            t0 = time.monotonic()
+            monitor.inc("service/client_bytes_sent",
+                        monitor.tree_bytes(args), op=op)
         with self._lock:
             self._conn.send((op, *args))
             status, payload = self._conn.recv()
         if status != "ok":
+            if mon:
+                monitor.inc("service/client_errors_total", op=op)
             raise RuntimeError(f"service error for {op}: {payload}")
+        if mon:
+            monitor.inc("service/client_bytes_recv",
+                        monitor.tree_bytes(payload), op=op)
+            monitor.observe("service/client_rpc_ms",
+                            (time.monotonic() - t0) * 1e3, op=op)
         return payload
 
     def close(self) -> None:
@@ -422,7 +469,18 @@ def main(argv=None) -> int:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     print(f"[service] listening on {args.host}:{args.port}", flush=True)
-    serve(args.host, args.port)
+    # telemetry for a standalone service process: request counters,
+    # per-op latency, connected-client gauge, heartbeat — activated by
+    # $THEANOMPI_TPU_MONITOR (no-op otherwise).  The stall watchdog is
+    # disabled (inf): a server's progress is request-driven, and an
+    # idle service is healthy, not stuck — progress_age_s in the
+    # heartbeat still shows time since the last served request.
+    # distinct file suffix: a tmserver sharing THEANOMPI_TPU_MONITOR
+    # with a trainer on the same host must not clobber rank0's files
+    with monitor.session(stall_after=float("inf"),
+                         name=f"service{os.getpid()}"):
+        monitor.progress(phase="serving")
+        serve(args.host, args.port)
     return 0
 
 

@@ -28,12 +28,14 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import uuid
 from typing import Any
 
 import jax
 import numpy as np
 
+from theanompi_tpu import monitor
 from theanompi_tpu.models.base import TpuModel
 from theanompi_tpu.parallel.exchanger import gosgd_merge, gosgd_scale_momentum
 from theanompi_tpu.parallel.mesh import data_mesh, replicate
@@ -202,15 +204,23 @@ class EASGD(_AsyncRule):
                         for it in range(n_iters):
                             if abort.is_set():
                                 return
+                            t_it = time.monotonic()
                             if it_total % tau == 0:
                                 recorder.start()
-                                new_params = srv.exchange(
-                                    model.state.params)
+                                with monitor.span("easgd/exchange",
+                                                  worker=str(rank)):
+                                    new_params = srv.exchange(
+                                        model.state.params)
                                 model.state = model.state.replace(
                                     params=new_params)
                                 recorder.end("comm")
                             model.train_iter(it, recorder)
                             it_total += 1
+                            # feeds the step histogram, heartbeat, and
+                            # the cross-worker straggler detector
+                            monitor.observe_step(
+                                time.monotonic() - t_it, phase="train",
+                                step=it_total, worker=rank)
                         model._flush_metrics(recorder)
                         model.adjust_hyperp(epoch + 1)
                         if rank == 0:
@@ -357,6 +367,7 @@ class ASGD(_AsyncRule):
                         for it in range(n_iters):
                             if abort.is_set():
                                 return
+                            t_it = time.monotonic()
                             recorder.start()
                             batch = next(model._train_iter)
                             recorder.end("wait")
@@ -365,7 +376,9 @@ class ASGD(_AsyncRule):
                                 model.state, batch, model._next_rng())
                             recorder.end("calc", block_on=metrics)
                             recorder.start()
-                            fresh = srv.push_pull(grads)
+                            with monitor.span("asgd/push_pull",
+                                              worker=str(rank)):
+                                fresh = srv.push_pull(grads)
                             model.state = model.state.replace(
                                 params=replicate(fresh, model.mesh),
                                 model_state=new_ms)
@@ -373,6 +386,9 @@ class ASGD(_AsyncRule):
                             recorder.train_metrics(float(metrics["loss"]),
                                                    float(metrics["error"]),
                                                    model.global_batch)
+                            monitor.observe_step(
+                                time.monotonic() - t_it, phase="train",
+                                step=it, worker=rank)
                         new_lr = model.adjust_hyperp(epoch + 1)
                         if rank == 0:
                             # the server's optimizer applies the updates,
@@ -547,6 +563,7 @@ class GOSGD(_AsyncRule):
                     for it in range(n_iters):
                         if abort.is_set():
                             return
+                        t_it = time.monotonic()
                         # merge anything gossiped to us
                         recorder.start()
                         for recv_params, recv_w in h.drain(rank):
@@ -577,9 +594,14 @@ class GOSGD(_AsyncRule):
                             dst = dst if dst < g_rank else dst + 1
                             recorder.start()
                             half = weights[rank] / 2.0
-                            if h.push(dst, model.state.params, half):
-                                weights[rank] = half
+                            with monitor.span("gosgd/push",
+                                              worker=str(rank)):
+                                if h.push(dst, model.state.params, half):
+                                    weights[rank] = half
                             recorder.end("comm")
+                        monitor.observe_step(
+                            time.monotonic() - t_it, phase="train",
+                            step=it, worker=rank)
                     model._flush_metrics(recorder)
                     model.adjust_hyperp(epoch + 1)
                     if ckpt is not None:

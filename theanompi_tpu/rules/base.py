@@ -136,8 +136,17 @@ class Rule:
                **kwargs):
         def run():
             try:
-                self._session(devs, modelfile, modelclass, config, resume,
-                              sync_type, **kwargs)
+                # telemetry for the whole session (no-op unless
+                # $THEANOMPI_TPU_MONITOR or a nested session enables
+                # it); an escaping exception triggers the postmortem
+                # dump before landing in self._error.  rank = host
+                # index so multi-host runs on a shared filesystem get
+                # distinct heartbeat/snapshot files.
+                from theanompi_tpu import monitor
+
+                with monitor.session(rank=jax.process_index()):
+                    self._session(devs, modelfile, modelclass, config,
+                                  resume, sync_type, **kwargs)
             except BaseException as e:  # propagated by wait()
                 traceback.print_exc()
                 self._error = e

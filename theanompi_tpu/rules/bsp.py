@@ -13,7 +13,9 @@ recorder bookkeeping.
 from __future__ import annotations
 
 import os
+import time
 
+from theanompi_tpu import monitor
 from theanompi_tpu.models.base import TpuModel
 from theanompi_tpu.parallel.mesh import data_mesh
 from theanompi_tpu.rules.base import Rule, resolve_model_class
@@ -26,11 +28,16 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
                     resume: bool = False, recorder: Recorder | None = None,
                     max_epochs: int | None = None,
                     checkpoint: bool = True,
-                    profile_dir: str | None = None) -> dict:
+                    profile_dir: str | None = None,
+                    monitor_dir: str | None = None) -> dict:
     """The BSP epoch loop (callable directly, e.g. from the launcher).
 
     ``profile_dir`` (or env ``THEANOMPI_TPU_PROFILE``) captures a
-    jax.profiler trace of the first steps — utils/profiling.py."""
+    jax.profiler trace of the first steps — utils/profiling.py.
+    ``monitor_dir`` (or env ``THEANOMPI_TPU_MONITOR``) activates the
+    telemetry subsystem: step-time histogram, per-phase spans,
+    heartbeat/watchdog, and a postmortem dump if the loop dies
+    (docs/OBSERVABILITY.md)."""
     cfg = model.config
     # multi-host: rank = host index, so only host 0 prints / writes the
     # JSONL curve (the reference's rank-0 gating, SURVEY.md §3.5)
@@ -40,62 +47,91 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
         save_dir=cfg.snapshot_dir if host == 0 else None,
         flops_per_sample=model.train_flops_per_sample)
     profiler = StepProfiler(profile_dir)
-    model.compile_iter_fns(sync_type)
+    with monitor.session(monitor_dir, rank=host):
+        monitor.progress(phase="compile")
+        with monitor.span("bsp/compile"):
+            model.compile_iter_fns(sync_type)
 
-    ckpt = None
-    start_epoch = 0
-    if checkpoint:
-        ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, model.name))
-        if resume:
-            latest = ckpt.latest_epoch()
-            if latest is not None:
-                payload = ckpt.restore(latest, like={
-                    "state": model.state, "epoch": 0})
-                # re-establish the model's sharding (a TP model would
-                # otherwise train on replicated restored arrays)
-                model.state = model.adopt_restored_state(payload["state"])
-                start_epoch = int(payload["epoch"]) + 1
-                recorder.load(cfg.snapshot_dir)
-                # fast-forward the LR schedule (reference resume semantics)
-                model.adjust_hyperp(start_epoch)
+        ckpt = None
+        start_epoch = 0
+        if checkpoint:
+            ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, model.name))
+            if resume:
+                latest = ckpt.latest_epoch()
+                if latest is not None:
+                    payload = ckpt.restore(latest, like={
+                        "state": model.state, "epoch": 0})
+                    # re-establish the model's sharding (a TP model would
+                    # otherwise train on replicated restored arrays)
+                    model.state = model.adopt_restored_state(
+                        payload["state"])
+                    start_epoch = int(payload["epoch"]) + 1
+                    recorder.load(cfg.snapshot_dir)
+                    # fast-forward the LR schedule (reference resume
+                    # semantics)
+                    model.adjust_hyperp(start_epoch)
 
-    n_epochs = model.n_epochs if max_epochs is None else min(
-        model.n_epochs, start_epoch + max_epochs)
-    last_val: dict = {}
-    profiler.maybe_start()
-    try:
-        for epoch in range(start_epoch, n_epochs):
-            n_iters = model.begin_epoch(epoch)
-            it = 0
-            k = max(getattr(model.config, "steps_per_call", 1),
-                    getattr(model.config, "grad_accum_steps", 1))
-            while it < n_iters:
-                # covers steps_per_call iterations per dispatch
-                consumed = model.train_iter(it, recorder)
-                if consumed is None:
-                    # legacy override that returns nothing — only valid
-                    # when each call consumes exactly one batch
-                    if k > 1:
-                        raise RuntimeError(
-                            f"{type(model).__name__}.train_iter returned "
-                            "None with a stacked cadence (steps_per_call"
-                            " or grad_accum_steps > 1); it must return "
-                            "the number of iterations consumed")
-                    consumed = 1
-                it += consumed
-                profiler.step()  # trace spans epochs until n_steps hit
-            model._flush_metrics(recorder)
-            last_val = model.val_epoch(recorder)  # times itself ('calc')
-            model.adjust_hyperp(epoch + 1)
-            if ckpt is not None:
-                ckpt.save(epoch, {"state": model.state, "epoch": epoch})
-            recorder.epoch_summary(epoch, last_val.get("loss"),
-                                   last_val.get("error"))
-    finally:
-        profiler.stop()
-        model.cleanup()  # also on failure: stops the prefetcher thread
-        if ckpt is not None:
-            ckpt.close()
+        n_epochs = model.n_epochs if max_epochs is None else min(
+            model.n_epochs, start_epoch + max_epochs)
+        last_val: dict = {}
+        with profiler:  # __exit__ stops the trace even on a crash
+            try:
+                for epoch in range(start_epoch, n_epochs):
+                    # the epoch number rides the heartbeat (progress
+                    # below) and this gauge, NOT a span label — a
+                    # per-epoch label would shatter span_ms into one
+                    # series per epoch
+                    monitor.set_gauge("bsp/epoch", epoch)
+                    with monitor.span("bsp/epoch"):
+                        n_iters = model.begin_epoch(epoch)
+                        it = 0
+                        k = max(getattr(model.config, "steps_per_call", 1),
+                                getattr(model.config, "grad_accum_steps", 1))
+                        while it < n_iters:
+                            # covers steps_per_call iterations per dispatch
+                            t0 = time.monotonic()
+                            consumed = model.train_iter(it, recorder)
+                            if consumed is None:
+                                # legacy override that returns nothing —
+                                # only valid when each call consumes
+                                # exactly one batch
+                                if k > 1:
+                                    raise RuntimeError(
+                                        f"{type(model).__name__}.train_iter"
+                                        " returned None with a stacked "
+                                        "cadence (steps_per_call or "
+                                        "grad_accum_steps > 1); it must "
+                                        "return the number of iterations "
+                                        "consumed")
+                                consumed = 1
+                            it += consumed
+                            # per-iteration time (dispatch wall / iters
+                            # covered); over a pipelined epoch the mean is
+                            # honest because dispatch backpressure tracks
+                            # device time
+                            monitor.observe_step(
+                                (time.monotonic() - t0) / consumed,
+                                phase="train", step=it)
+                            profiler.step()  # trace spans epochs until
+                            # n_steps hit
+                        model._flush_metrics(recorder)
+                        monitor.progress(phase="validate")
+                        with monitor.span("bsp/validate"):
+                            last_val = model.val_epoch(recorder)
+                            # times itself ('calc')
+                        model.adjust_hyperp(epoch + 1)
+                        if ckpt is not None:
+                            monitor.progress(phase="checkpoint")
+                            with monitor.span("bsp/checkpoint"):
+                                ckpt.save(epoch, {"state": model.state,
+                                                  "epoch": epoch})
+                        recorder.epoch_summary(epoch, last_val.get("loss"),
+                                               last_val.get("error"))
+                        monitor.progress(phase="epoch_end", step=epoch)
+            finally:
+                model.cleanup()  # also on failure: stops the prefetcher
+                if ckpt is not None:
+                    ckpt.close()
     return {"val": last_val, "epochs_run": n_epochs - start_epoch,
             "records": recorder.epoch_records}
 
@@ -115,6 +151,7 @@ class BSP(Rule):
                  sync_type, max_epochs=None, checkpoint=True,
                  model_parallel: int = 1, seq_parallel: int = 1,
                  pipe_parallel: int = 1, expert_parallel: int = 1,
+                 monitor_dir: str | None = None,
                  **kwargs):
         if (model_parallel > 1 or seq_parallel > 1 or pipe_parallel > 1
                 or expert_parallel > 1):
@@ -133,4 +170,5 @@ class BSP(Rule):
         self.model = cls(config=config, mesh=mesh, **kwargs)
         self.result = run_bsp_session(self.model, sync_type=sync_type,
                                       resume=resume, max_epochs=max_epochs,
-                                      checkpoint=checkpoint)
+                                      checkpoint=checkpoint,
+                                      monitor_dir=monitor_dir)

@@ -25,7 +25,13 @@ class StepProfiler:
     """Trace the first ``n_steps`` training iterations, then stop.
 
     No-op unless a log dir is configured, so the session loop can call
-    it unconditionally."""
+    it unconditionally.
+
+    Also a context manager: ``with StepProfiler(dir):`` starts the
+    capture on entry and guarantees ``stop()`` on exit — a crash
+    mid-capture still flushes a loadable trace instead of losing the
+    whole capture (``jax.profiler.stop_trace`` is what writes the
+    files)."""
 
     def __init__(self, log_dir: str | None = None,
                  n_steps: int | None = None):
@@ -40,6 +46,13 @@ class StepProfiler:
     @property
     def enabled(self) -> bool:
         return bool(self.log_dir)
+
+    def __enter__(self) -> "StepProfiler":
+        self.maybe_start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
 
     def maybe_start(self) -> None:
         if self.log_dir and not self._active and not self._done:

@@ -23,7 +23,48 @@ cd "$(dirname "$0")/.."
   THEANOMPI_TPU_ENTRY_CPU=1 python __graft_entry__.py
   ENTRY_RC=$?
   echo "graft_entry rc=$ENTRY_RC"
-  if [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ]; then
+  echo "## monitor smoke (5-step CPU BSP with THEANOMPI_TPU_MONITOR)"
+  # telemetry end-to-end: the snapshot JSONL must parse and carry the
+  # core series, and the heartbeat must be fresh (docs/OBSERVABILITY.md)
+  MONDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$MONDIR" python - <<'PYEOF'
+import json, os, sys, time
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from theanompi_tpu.data.cifar10 import Cifar10_data
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.models.cifar10 import Cifar10_model
+from theanompi_tpu.parallel import data_mesh
+from theanompi_tpu.rules.bsp import run_bsp_session
+
+class Tiny(Cifar10_model):
+    def build_data(self):
+        return Cifar10_data(synthetic_n=80)  # 5 iters at batch 2 x 8
+
+cfg = ModelConfig(batch_size=2, n_epochs=1, print_freq=10**9,
+                  compute_dtype="float32")
+run_bsp_session(Tiny(config=cfg, mesh=data_mesh(8)), max_epochs=1,
+                checkpoint=False)
+mondir = os.environ["THEANOMPI_TPU_MONITOR"]
+recs = [json.loads(l)
+        for l in open(os.path.join(mondir, "metrics_rank0.jsonl"))]
+names = {r["name"] for r in recs}
+missing = {"step_ms", "span_ms", "recorder/section_ms"} - names
+assert not missing, f"snapshot missing core series: {missing}"
+steps = next(r for r in recs if r["name"] == "step_ms")
+assert steps["count"] == 5, f"expected 5 step observations: {steps}"
+hb = json.load(open(os.path.join(mondir, "heartbeat_rank0.json")))
+assert time.time() - hb["written"] < 120, f"stale heartbeat: {hb}"
+assert hb["stalled"] is False
+print(f"monitor smoke OK: {len(names)} series, "
+      f"step p50 {steps['p50']:.1f}ms, heartbeat fresh")
+PYEOF
+  MONITOR_RC=$?
+  rm -rf "$MONDIR"
+  echo "monitor smoke rc=$MONITOR_RC"
+  if [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     exit 1
   fi
