@@ -33,6 +33,16 @@ import time
 # so the probe path's wedge isolation still relies on the SUBPROCESS
 # probe (importing jax is safe; creating a backend is what hangs)
 from theanompi_tpu import monitor
+from theanompi_tpu.resilience.retry import RetryPolicy
+
+# probe-retry backoff (resilience.retry): exponential 5s -> 30s with
+# jitter replaces the old flat 30 s sleeps — early attempts re-probe a
+# transient relay restart quickly, later ones stop hammering a wedge.
+# max_attempts is unused here (the window is the probe loop's own
+# deadline); only delay() is consumed.
+_PROBE_BACKOFF = RetryPolicy(base_delay=5.0, max_delay=30.0,
+                             multiplier=2.0, jitter=0.25,
+                             name="bench_probe")
 
 BASELINE_PER_CHIP = 2500.0 / 16.0  # north-star v5e-16 target, per chip
 E2E_STEPS = int(os.environ.get("THEANOMPI_TPU_BENCH_E2E_STEPS", "64"))
@@ -288,7 +298,8 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
             _STATUS["last_error"] = last_err
             _timeline("probe_attempt_hang", attempt=attempts,
                       waited_s=round(time.monotonic() - t_attempt, 1))
-            time.sleep(min(30.0, max(0.0, deadline - time.monotonic())))
+            time.sleep(min(_PROBE_BACKOFF.delay(attempts - 1),
+                           max(0.0, deadline - time.monotonic())))
             continue
         out = stdout.strip().splitlines()
         if rc == 0 and out:
@@ -318,10 +329,12 @@ def _probe_backend(window_s: int = PROBE_WINDOW_S) -> tuple[str | None, str]:
         if any(s in err for s in deterministic):
             return None, f"{err} — not retrying (misconfig, not a wedge)"
         _heartbeat(f"probe attempt {attempts} failed: {err[:120]}")
-        # back off, but never sleep away the final attempt's window —
-        # the post-UNAVAILABLE recovery attempt is the whole point
+        # back off (exponential + jitter), but never sleep away the
+        # final attempt's window — the post-UNAVAILABLE recovery
+        # attempt is the whole point
         remaining = deadline - time.monotonic()
-        time.sleep(min(30.0, max(0.0, remaining - 60.0)))
+        time.sleep(min(_PROBE_BACKOFF.delay(attempts - 1),
+                       max(0.0, remaining - 60.0)))
 
 
 import jax
@@ -492,17 +505,27 @@ def _main() -> int:
     # between the print and the phase='done' flip would append a
     # failure JSON line after (or interleaved into) the success line,
     # and a last-line parser would record 0.0 despite a completed
-    # measurement (round-4 advisor finding).  SIG_IGN — not a signal
-    # mask: process-directed signals can be delivered to any JAX/
-    # prefetcher thread, and CPython still runs the Python handler in
-    # the main thread regardless of the main thread's mask, so masking
-    # does not close the race (round-5 review).  Ignoring drops the
-    # signal entirely; the measurement is done, so the only thing a
-    # late TERM could still do is skip teardown — and the driver's
-    # SIGKILL escalation covers a teardown wedge either way.
+    # measurement (round-4 advisor finding).  Three belts, closing the
+    # race from every end:
+    #   1. pthread_sigmask blocks delivery to THIS (main) thread for
+    #      the print window — the advisor's requested guard;
+    #   2. SIG_IGN drops process-directed signals landing on any
+    #      OTHER (JAX/prefetcher) thread — masking only the main
+    #      thread does not cover those, since CPython runs the Python
+    #      handler in the main thread regardless of which thread the
+    #      OS delivered to (round-5 review);
+    #   3. phase='done' flips BEFORE the print, so a handler that
+    #      somehow still fires exits 0 without appending a failure
+    #      line mid-stream.
+    # The measurement is done; the only thing a late TERM could still
+    # do is skip teardown — and the driver's SIGKILL escalation covers
+    # a teardown wedge either way.
     import signal as _signal
+    _signal.pthread_sigmask(_signal.SIG_BLOCK,
+                            {_signal.SIGTERM, _signal.SIGINT})
     _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
     _signal.signal(_signal.SIGINT, _signal.SIG_IGN)
+    _STATUS["phase"] = "done"
     print(json.dumps({
         "metric": "resnet50_imagenet_bsp_images_per_sec_per_chip",
         "value": round(step_per_chip, 2),
@@ -532,7 +555,6 @@ def _main() -> int:
             "backend": jax.default_backend(),
         },
     }), flush=True)
-    _STATUS["phase"] = "done"
     return 0
 
 

@@ -101,6 +101,24 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="write the session result (val metrics + scalar "
                         "rule stats, e.g. GOSGD gossip weights, EASGD "
                         "n_exchanges) as JSON — param trees are omitted")
+    p.add_argument("--max-restarts", type=int, default=0, metavar="N",
+                   help="resilience (docs/RESILIENCE.md): async rules "
+                        "restart a crashed worker thread from the center "
+                        "params up to N times (quorum-bounded); under "
+                        "tmlocal any rule additionally auto-resumes a "
+                        "crashed session from its latest verified "
+                        "checkpoint up to N times (requires "
+                        "checkpointing, the default).  Session "
+                        "auto-resume is single-host only — one host of "
+                        "a tmlauncher SPMD program cannot rejoin the "
+                        "collectives its peers are mid-flight in. "
+                        "0 = the reference's fail-fast behavior")
+    p.add_argument("--fault-plan", default=None, metavar="PATH|JSON",
+                   help="activate the deterministic fault-injection "
+                        "plane with this plan (a JSON file path or "
+                        "inline JSON; docs/RESILIENCE.md); equivalent "
+                        "to setting THEANOMPI_TPU_FAULTS — exported so "
+                        "subprocesses inherit it")
     p.add_argument("--monitor-dir", default=None, metavar="DIR",
                    help="enable the telemetry subsystem and write its "
                         "artifacts (metrics snapshot JSONL + Prometheus "
@@ -177,6 +195,15 @@ def _run(args, multihost: bool) -> int:
         import os
 
         os.environ["THEANOMPI_TPU_MONITOR"] = args.monitor_dir
+    if args.fault_plan:
+        import os
+
+        os.environ["THEANOMPI_TPU_FAULTS"] = args.fault_plan
+        # the package may already be imported (env read at import
+        # happened before argv parsing) — re-read explicitly
+        from theanompi_tpu.resilience import faults
+
+        faults.install_from_env()
     if args.platform:
         import jax
 
@@ -231,12 +258,59 @@ def _run(args, multihost: bool) -> int:
                       n_total_workers=args.n_total_workers,
                       rank_offset=args.rank_offset,
                       merge_momentum=args.merge_momentum)
-    if args.rule != "BSP" and args.server_addr:
-        kwargs.update(server_addr=args.server_addr)
-        if args.session_id:
-            kwargs.update(session_id=args.session_id)
-    rule.init(**kwargs)
-    result = rule.wait()
+    if args.rule != "BSP":
+        if args.server_addr:
+            kwargs.update(server_addr=args.server_addr)
+            if args.session_id:
+                kwargs.update(session_id=args.session_id)
+        if args.max_restarts:
+            # worker-thread supervision (resilience.supervisor) — the
+            # first line of defense; the session-level auto-resume
+            # below catches what it can't
+            kwargs.update(max_restarts=args.max_restarts)
+    # session-level auto-resume (docs/RESILIENCE.md): a crashed
+    # session restarts from its latest VERIFIED checkpoint — corrupt
+    # latest falls back to the previous kept epoch (rules' resume
+    # paths go through resilience.recovery).  Single-host only: one
+    # host of a multi-host SPMD program resuming alone would issue
+    # collectives its peers (blocked mid-all-reduce at a different
+    # step) can never match — fail fast on every host instead.
+    session_restarts = 0 if multihost else args.max_restarts
+    attempts = 0
+    while True:
+        rule.init(**kwargs)
+        try:
+            result = rule.wait()
+            break
+        except Exception as e:
+            attempts += 1
+            if attempts > session_restarts:
+                raise
+            import sys as _sys
+
+            if (args.rule == "GOSGD" and args.server_addr
+                    and args.session_id):
+                # a pinned-session-id gossip hub survives the crash
+                # WITH its deactivated ranks and stale in-flight
+                # payloads — resuming into it would refuse gossip to
+                # restarted ranks and merge pre-crash params; the
+                # operator must restart every host with a fresh id
+                print("[resilience] NOT auto-resuming GOSGD: the "
+                      f"pinned --session-id {args.session_id!r} hub "
+                      "keeps deactivated ranks and stale in-flight "
+                      "gossip across a resume; restart all hosts "
+                      "with a fresh --session-id", file=_sys.stderr,
+                      flush=True)
+                raise
+            print(f"[resilience] {args.rule} session died "
+                  f"({type(e).__name__}: {e}); auto-resume "
+                  f"{attempts}/{session_restarts} from the latest "
+                  "verified checkpoint", file=_sys.stderr, flush=True)
+            from theanompi_tpu import monitor
+
+            monitor.inc("resilience/session_autoresumes_total")
+            kwargs.update(resume=True)
+            rule = rule_cls()
     val = result.get("val", {})
     if val:
         print("final val:", {k: round(float(v), 4) for k, v in val.items()})

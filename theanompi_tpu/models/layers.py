@@ -109,7 +109,16 @@ class BatchNorm(nn.Module):
     the knob ResNet wires from ModelConfig.sync_bn (resnet50.py uses
     flax nn.BatchNorm directly; this wrapper exposes the same choice
     to zoo models built from the layer toolkit): required when the
-    per-shard batch is too small for its statistics to serve eval."""
+    per-shard batch is too small for its statistics to serve eval.
+
+    WIRING OBLIGATION (ADVICE r4): ``ModelConfig.sync_bn`` does NOT
+    reach this wrapper automatically — a ``build_module()`` that uses
+    it must pass ``axis_name=self._bn_axis()`` (models/base.py), or
+    ``sync_bn=True`` silently keeps per-shard stats.  Today only the
+    ResNet family threads the knob; ``TpuModel`` warns at compile when
+    a ``uses_batchnorm`` model has a small per-shard batch and
+    ``sync_bn`` off.  Regression:
+    tests/test_model_zoo.py::TestLayersBatchNormSyncWiring."""
 
     use_running_average: bool = False
     momentum: float = 0.9

@@ -33,6 +33,7 @@ import numpy as np
 import optax
 
 from theanompi_tpu.parallel.exchanger import easgd_both_updates
+from theanompi_tpu.resilience import faults
 
 PyTree = Any
 
@@ -63,6 +64,9 @@ class EASGDServer:
         k+1 must see exchange k's center, so it blocks until k's device
         work finishes — but worker k keeps training in the meantime.
         """
+        # fault plane: the 'raise in an exchanger hook' site — a no-op
+        # (one is-None check) without an installed plan
+        faults.fire("exchange", kind="easgd")
         with self._lock:
             # prior center may be an un-fetched device array committed to
             # another worker's device; materialize on host so this
@@ -117,6 +121,7 @@ class ASGDServer:
         Grads are fetched to host first: workers live on different
         devices, and the center is committed to the server's device
         (the reference's server owned its own GPU the same way)."""
+        faults.fire("exchange", kind="asgd")
         host_grads = jax.device_get(grads)
         with self._lock:
             self._center, self._opt_state = self._apply(
@@ -157,6 +162,7 @@ class GossipHub:
         Pushes to deactivated (finished) workers are refused, otherwise
         stragglers would bleed gossip weight into inboxes nobody drains
         (breaking the sum-of-weights≈1 conservation invariant)."""
+        faults.fire("exchange", kind="gosgd")
         if not self._active[dst]:
             return False
         payload = (jax.tree.map(np.asarray, params), float(weight))

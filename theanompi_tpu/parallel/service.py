@@ -44,6 +44,8 @@ import jax
 import numpy as np
 
 from theanompi_tpu import monitor
+from theanompi_tpu.resilience import faults
+from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
 
 PyTree = Any
 
@@ -143,6 +145,58 @@ class ParamService:
             if self._fresh("gosgd", session_id):
                 self._stores["gosgd"] = self._classes["gosgd"](n_workers)
 
+    def rejoin(self, kind: str, session_id: str, payload):
+        """Session fencing for a worker reconnecting after a transport
+        failure (docs/RESILIENCE.md).  Three cases:
+
+        * the service never lost the session → plain join;
+        * the session was DISPLACED by a newer one → refuse (same
+          fail-fast as ``_store`` — a rejoined worker must not train
+          against a stranger's center);
+        * the service itself restarted (fresh process, no sessions) →
+          rebuild the store from the surviving worker's payload —
+          EASGD: (params, alpha) re-seeds the center from the worker's
+          last good params; ASGD: (params, opt_cfg) re-seeds center +
+          a FRESH optimizer state (server momentum is lost across a
+          service restart — documented); GOSGD: (n_workers,) — the hub
+          holds only in-flight gossip, which dies with the service.
+        A client with no rebuild payload yet (a joiner before its
+        first exchange) raises; its retry loop keeps rejoining until a
+        payload-bearing peer has rebuilt the store."""
+        with self._init_lock:
+            cur = self._sessions.get(kind)
+            if cur == session_id:
+                return "joined"
+            if cur is not None:
+                raise SessionDisplaced(
+                    f"{kind} session {session_id!r} was displaced by "
+                    f"{cur!r}; refusing rejoin (this training session "
+                    "is stale)")
+            if payload is None:
+                raise RuntimeError(
+                    f"{kind} session {session_id!r} is gone (service "
+                    "restart) and this client has no rebuild payload; "
+                    "waiting for a peer that does")
+            if kind == "easgd":
+                params, alpha = payload
+                self._stores["easgd"] = self._classes["easgd"](
+                    params, alpha=float(alpha))
+            elif kind == "asgd":
+                params, opt_cfg = payload
+                self._stores["asgd"] = self._classes["asgd"](
+                    params, build_optimizer(**opt_cfg))
+            elif kind == "gosgd":
+                (n_workers,) = payload
+                self._stores["gosgd"] = self._classes["gosgd"](
+                    int(n_workers))
+            else:
+                raise ValueError(f"unknown store kind {kind!r}")
+            self._sessions[kind] = session_id
+            monitor.inc("service/session_rebuilds_total", kind=kind)
+            print(f"[service] rebuilt {kind} session {session_id!r} "
+                  "from a rejoining worker's payload", flush=True)
+            return "rebuilt"
+
     def join(self, kind: str, session_id: str):
         """Cheap membership check for non-creator workers: validates
         the session exists WITHOUT re-shipping the init payload (N
@@ -171,7 +225,8 @@ class ParamService:
     # -- dispatch: store ops carry (op, session_id, *args) --
 
     def handle(self, op: str, *args):
-        if op in ("easgd_init", "asgd_init", "gosgd_init", "join"):
+        if op in ("easgd_init", "asgd_init", "gosgd_init", "join",
+                  "rejoin"):
             return getattr(self, op)(*args)
         if op == "stats":
             out = {}
@@ -319,20 +374,110 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
 # ---------------------------------------------------------------------------
 
 
-class ServiceClient:
-    """One persistent authenticated connection; thread-safe call().
-    ``authkey=None`` requires ``THEANOMPI_TPU_SERVICE_KEY`` (raising
-    BEFORE any network touch when unset — there is no default key)."""
+def _default_wire_retry() -> RetryPolicy:
+    """The client reconnect policy (env-tunable): enough patience for
+    a parameter-service restart (process relaunch ~seconds), bounded
+    so a permanently-gone service still fails in finite time."""
+    return RetryPolicy(
+        max_attempts=int(os.environ.get(
+            "THEANOMPI_TPU_SERVICE_RETRIES", "8")),
+        base_delay=0.1, max_delay=2.0, multiplier=2.0, jitter=0.5,
+        deadline_s=float(os.environ.get(
+            "THEANOMPI_TPU_SERVICE_RETRY_DEADLINE_S", "30")),
+        name="service_client")
 
-    def __init__(self, address: str, authkey: bytes | None = None):
+
+class ServiceError(RuntimeError):
+    """A server-side 'err' reply — the op reached the service and was
+    rejected there, so reconnecting cannot fix it (never retried)."""
+
+
+class SessionDisplaced(RuntimeError):
+    """A rejoin refused because a NEWER session owns the store.  Its
+    class name rides the wire in the err reply (the service prefixes
+    every error with ``type(e).__name__``), giving the client a typed
+    marker to classify on instead of prose."""
+
+
+#: sentinel: "no reply received yet" in ServiceClient.call's retry loop
+_PENDING = object()
+
+#: ops whose server-side effect is a destructive one-shot (a drain
+#: pops inboxes; a push deposits gossip weight): once the request has
+#: been SENT, a lost reply must NOT trigger a re-send — re-applying
+#: would double-deliver weight or silently discard a drained payload,
+#: breaking GOSGD's sum-of-weights conservation.  These ops get
+#: at-MOST-once delivery across transport failures; everything else
+#: (elastic exchanges, grad pushes, reads, inits) tolerates
+#: at-least-once.
+AT_MOST_ONCE_OPS = frozenset({"gosgd_push", "gosgd_drain"})
+
+
+class ServiceClient:
+    """One persistent authenticated connection; thread-safe call()
+    with reconnect-with-backoff (resilience.retry): a transport
+    failure mid-call closes the connection, backs off, reconnects,
+    lets the subclass re-establish its session (``_rejoin`` — see
+    ``ParamService.rejoin`` on service-restart semantics), and
+    re-sends.  Delivery is AT-LEAST-ONCE across transport failures
+    for ops whose double-application the rules' arithmetic tolerates
+    (one extra elastic pull / duplicate grad push), but AT-MOST-ONCE
+    for ``AT_MOST_ONCE_OPS`` (gossip push/drain): once such a request
+    has been sent, a lost reply raises instead of re-sending — the
+    server may have applied the destructive op already, and a silent
+    re-apply would corrupt GOSGD's gossip-weight conservation
+    (docs/RESILIENCE.md).  Server-side errors (``ServiceError``) are
+    never retried.  ``authkey=None`` requires
+    ``THEANOMPI_TPU_SERVICE_KEY`` (raising BEFORE any network touch
+    when unset — there is no default key)."""
+
+    def __init__(self, address: str, authkey: bytes | None = None,
+                 retry: RetryPolicy | None = None):
         host, _, port = address.rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
-        self._conn = Client(self.address,
-                            authkey=authkey if authkey is not None
-                            else _authkey())
+        self._authkey = authkey if authkey is not None else _authkey()
+        self._retry = retry if retry is not None else _default_wire_retry()
         self._lock = threading.Lock()
+        self._conn = Client(self.address, authkey=self._authkey)
+
+    # -- transport -----------------------------------------------------
+
+    def _reconnect(self) -> None:
+        with self._lock:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = Client(self.address, authkey=self._authkey)
+
+    def _rejoin(self) -> None:
+        """Subclass hook: re-establish server-side session state after
+        a reconnect (the base client is session-less)."""
+
+    def _call_once(self, op: str, *args):
+        """One send/recv on the current connection; raises transport
+        errors (retryable) or ServiceError (not).  Transport errors
+        are tagged with whether the request had already been SENT —
+        the retry loop needs it to keep AT_MOST_ONCE_OPS from being
+        re-applied after a lost reply."""
+        with self._lock:
+            sent = False
+            try:
+                self._conn.send((op, *args))
+                sent = True
+                status, payload = self._conn.recv()
+            except CONNECTION_ERRORS as e:
+                e._tm_sent = sent
+                raise
+        if status != "ok":
+            raise ServiceError(f"service error for {op}: {payload}")
+        return payload
 
     def call(self, op: str, *args):
+        # fault plane (no-op without a plan): 'drop' synthesizes a
+        # transport failure below so the Kth RPC exercises the real
+        # reconnect path; 'delay' sleeps in fire(); 'raise' propagates
+        fault = faults.fire("service_call", op=op)
         # byte/latency accounting only when telemetry is live: the
         # tree walk is cheap but not free, and the disabled path must
         # stay a pure transport
@@ -341,13 +486,74 @@ class ServiceClient:
             t0 = time.monotonic()
             monitor.inc("service/client_bytes_sent",
                         monitor.tree_bytes(args), op=op)
-        with self._lock:
-            self._conn.send((op, *args))
-            status, payload = self._conn.recv()
-        if status != "ok":
-            if mon:
-                monitor.inc("service/client_errors_total", op=op)
-            raise RuntimeError(f"service error for {op}: {payload}")
+        t_start = time.monotonic()
+        last: BaseException | None = None
+        needs_rejoin = False
+        payload = _PENDING
+        for attempt in range(self._retry.max_attempts):
+            if attempt:
+                deadline = self._retry.deadline_s
+                if (deadline is not None
+                        and time.monotonic() - t_start > deadline):
+                    break
+                time.sleep(self._retry.delay(attempt - 1))
+            try:
+                if needs_rejoin:
+                    # re-establish transport AND session before
+                    # re-sending; a failure here (service still down,
+                    # or the store not rebuilt yet — a payload-bearing
+                    # peer may rebuild it any moment) re-enters the
+                    # retry loop rather than sending an op the server
+                    # must reject
+                    self._reconnect()
+                    self._rejoin()
+                    needs_rejoin = False
+                if fault == "drop":
+                    fault = None  # drop once, then the retry proceeds
+                    raise ConnectionResetError(
+                        "injected service_call drop (fault plan)")
+                payload = self._call_once(op, *args)
+                break
+            except ServiceError as e:
+                if needs_rejoin:
+                    # typed marker: the service prefixes every err
+                    # reply with the exception class name, so this
+                    # matches SessionDisplaced, not prose wording
+                    if SessionDisplaced.__name__ in str(e):
+                        # permanent: this session is stale (a newer
+                        # one owns the store) — retrying would only
+                        # dress a session error up as a network one
+                        raise
+                    last = e  # store not rebuilt yet — keep rejoining
+                    continue
+                if mon:
+                    monitor.inc("service/client_errors_total", op=op)
+                raise
+            except CONNECTION_ERRORS as e:
+                if (op in AT_MOST_ONCE_OPS
+                        and getattr(e, "_tm_sent", False)):
+                    # the request reached the wire and the REPLY was
+                    # lost: the server may have applied this
+                    # destructive op already — surfacing beats
+                    # silently corrupting gossip-weight conservation
+                    raise ConnectionError(
+                        f"reply lost for non-idempotent {op}; not "
+                        "re-sending (the server may have applied it "
+                        f"already): {e}") from e
+                last = e
+                needs_rejoin = True
+                monitor.inc("service/client_reconnects_total", op=op)
+        if payload is _PENDING:  # attempts or deadline exhausted
+            elapsed = time.monotonic() - t_start
+            if isinstance(last, ServiceError):
+                # the TRANSPORT recovered; what never came back was
+                # the session store — name the real problem
+                raise ServiceError(
+                    f"session not re-established for {op} after "
+                    f"{elapsed:.1f}s: {last}") from last
+            raise ConnectionError(
+                f"service at {self.address} unreachable for {op} "
+                f"after {elapsed:.1f}s: {last}") from last
         if mon:
             monitor.inc("service/client_bytes_recv",
                         monitor.tree_bytes(payload), op=op)
@@ -378,15 +584,29 @@ class RemoteEASGD(ServiceClient):
                  session_id: str = "default"):
         super().__init__(address)
         self._sid = str(session_id)
+        self._alpha = float(alpha)
+        # rebuild payload for a rejoin after a SERVICE restart: the
+        # creator's init params, refreshed with every exchange result
+        # (a joiner has none until its first exchange — its rejoin
+        # waits for a payload-bearing peer, see ParamService.rejoin)
+        self._rebuild = None if params is None \
+            else _np(jax.device_get(params))
         if params is None:
             self.call("join", "easgd", self._sid)
         else:
-            self.call("easgd_init", _np(jax.device_get(params)),
-                      float(alpha), self._sid)
+            self.call("easgd_init", self._rebuild, self._alpha, self._sid)
+
+    def _rejoin(self) -> None:
+        self._call_once(
+            "rejoin", "easgd", self._sid,
+            None if self._rebuild is None
+            else (self._rebuild, self._alpha))
 
     def exchange(self, worker_params: PyTree) -> PyTree:
-        return self.call("easgd_exchange", self._sid,
-                         _np(jax.device_get(worker_params)))
+        out = self.call("easgd_exchange", self._sid,
+                        _np(jax.device_get(worker_params)))
+        self._rebuild = out
+        return out
 
     def get_center(self) -> PyTree:
         return self.call("easgd_get_center", self._sid)
@@ -404,17 +624,31 @@ class RemoteASGD(ServiceClient):
                  session_id: str = "default"):
         super().__init__(address)
         self._sid = str(session_id)
+        self._opt_cfg = dict(opt_cfg)
+        # rebuild payload: latest known CENTER (init params, refreshed
+        # by every push_pull reply).  A rejoin after a service restart
+        # re-seeds the center from it with a fresh optimizer state —
+        # server momentum does not survive a service restart.
+        self._rebuild = None if params is None \
+            else _np(jax.device_get(params))
         if params is None:
             self.call("join", "asgd", self._sid)
         else:
-            self.call("asgd_init", _np(jax.device_get(params)),
-                      dict(opt_cfg),
+            self.call("asgd_init", self._rebuild, self._opt_cfg,
                       None if opt_state is None
                       else _np(jax.device_get(opt_state)), self._sid)
 
+    def _rejoin(self) -> None:
+        self._call_once(
+            "rejoin", "asgd", self._sid,
+            None if self._rebuild is None
+            else (self._rebuild, self._opt_cfg))
+
     def push_pull(self, grads: PyTree) -> PyTree:
-        return self.call("asgd_push_pull", self._sid,
-                         _np(jax.device_get(grads)))
+        out = self.call("asgd_push_pull", self._sid,
+                        _np(jax.device_get(grads)))
+        self._rebuild = out
+        return out
 
     def set_lr(self, lr: float) -> None:
         self.call("asgd_set_lr", self._sid, float(lr))
@@ -443,6 +677,12 @@ class RemoteGossipHub(ServiceClient):
         self.n_workers = n_workers
         self.rank_offset = rank_offset
         self.call("gosgd_init", int(n_workers), self._sid)
+
+    def _rejoin(self) -> None:
+        # always rebuildable: the hub holds only in-flight gossip,
+        # which legitimately dies with the service
+        self._call_once("rejoin", "gosgd", self._sid,
+                        (int(self.n_workers),))
 
     def push(self, dst: int, params: PyTree, weight: float) -> bool:
         return self.call("gosgd_push", self._sid, int(dst),

@@ -1,0 +1,244 @@
+"""Crash auto-resume: checkpoint integrity + verified restore.
+
+A checkpoint that *exists* is not a checkpoint that *restores*: a
+worker killed mid-write, a full disk, or plain bit rot leaves a step
+directory that Orbax only rejects at resume time — which, before this
+module, killed the resume and with it the whole recovery story.
+
+Mechanism (wired through ``utils/checkpoint.Checkpointer``):
+
+* **manifest** — after every *completed* Orbax write (queued at fence
+  time, so the async write has landed; digested on the Checkpointer's
+  background worker, off the training thread),
+  ``manifest_{epoch}.json`` is written beside the step directory
+  carrying per-file sizes + sha256 digests.  Manifests are pruned in
+  step with ``max_to_keep``.
+* **verify** — :func:`verify_checkpoint` recomputes sizes/digests
+  against the manifest; a checkpoint with no manifest (pre-resilience
+  or foreign) is *unverifiable*, not invalid — the restore itself is
+  then the arbiter.
+* **fallback** — :func:`restore_latest_verified` walks kept epochs
+  newest-first, skipping any that fail verification (or whose actual
+  restore raises), and returns the first that loads — so a truncated
+  latest checkpoint costs one epoch of progress, not the run.  Used by
+  every rule's resume path (``rules/base.py Rule._restore_latest``).
+* **crash marker** — :func:`record_crash` is the postmortem hook: when
+  a rule session dies with monitoring enabled, a small
+  ``resilience_crash_*.json`` lands in the monitor run dir naming the
+  rule, the error, and the newest manifest-bearing checkpoint — the
+  machine-readable resume hint for the launcher's ``--max-restarts``
+  auto-resume loop (or an operator).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Any
+
+from theanompi_tpu import monitor
+
+PyTree = Any
+
+_CHUNK = 1 << 20
+
+
+def manifest_path(directory: str, epoch: int) -> str:
+    return os.path.join(directory, f"manifest_{int(epoch)}.json")
+
+
+def _digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                return h.hexdigest()
+            h.update(chunk)
+
+
+def _walk_files(step_dir: str) -> dict[str, str]:
+    out = {}
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            out[os.path.relpath(full, step_dir)] = full
+    return out
+
+
+def write_manifest(directory: str, epoch: int, step_dir: str) -> str:
+    """Digest every file under ``step_dir`` into
+    ``manifest_{epoch}.json`` (atomic rename — a crash mid-manifest
+    must not leave a half-written manifest that fails every verify)."""
+    files = {
+        rel: {"size": os.path.getsize(full), "sha256": _digest(full)}
+        for rel, full in sorted(_walk_files(step_dir).items())
+    }
+    path = manifest_path(directory, epoch)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({"epoch": int(epoch), "written": time.time(),
+                   "n_files": len(files), "files": files}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def find_step_dir(directory: str, epoch: int) -> str | None:
+    """The Orbax step directory for ``epoch`` — plain ``str(epoch)``
+    by default, with a scan fallback for zero-padded step formats."""
+    cand = os.path.join(directory, str(int(epoch)))
+    if os.path.isdir(cand):
+        return cand
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        if name.isdigit() and int(name) == int(epoch):
+            path = os.path.join(directory, name)
+            if os.path.isdir(path):
+                return path
+    return None
+
+
+def verify_checkpoint(directory: str, epoch: int,
+                      step_dir: str | None = None
+                      ) -> tuple[bool | None, str]:
+    """(ok, detail): True = verified, False = corrupt (with the first
+    mismatch in ``detail``), None = no manifest to verify against."""
+    mpath = manifest_path(directory, epoch)
+    if not os.path.exists(mpath):
+        return None, "no manifest"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest: {e}"
+    if step_dir is None:
+        step_dir = find_step_dir(directory, epoch)
+    if step_dir is None or not os.path.isdir(step_dir):
+        return False, f"step dir missing for epoch {epoch}"
+    on_disk = _walk_files(step_dir)
+    for rel, want in manifest.get("files", {}).items():
+        full = on_disk.get(rel)
+        if full is None:
+            return False, f"missing file: {rel}"
+        size = os.path.getsize(full)
+        if size != want["size"]:
+            return False, (f"size mismatch {rel}: "
+                           f"{size} != {want['size']}")
+        if _digest(full) != want["sha256"]:
+            return False, f"digest mismatch: {rel}"
+    return True, f"{manifest.get('n_files', 0)} files verified"
+
+
+def prune_manifests(directory: str, kept_epochs: set[int]) -> None:
+    """Drop manifests of epochs Orbax's ``max_to_keep`` pruned."""
+    import glob
+    import re
+
+    for path in glob.glob(os.path.join(directory, "manifest_*.json")):
+        m = re.search(r"manifest_(\d+)\.json$", path)
+        if m and int(m.group(1)) not in kept_epochs:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+def restore_latest_verified(ckpt, like: PyTree | None = None
+                            ) -> tuple[int | None, PyTree | None]:
+    """(epoch, payload) of the newest checkpoint that verifies AND
+    restores; (None, None) when nothing is restorable.  ``ckpt`` is a
+    ``utils.checkpoint.Checkpointer`` (duck-typed: ``kept_epochs``,
+    ``directory``, ``restore``)."""
+    epochs = sorted(ckpt.kept_epochs(), reverse=True)
+    for i, epoch in enumerate(epochs):
+        ok, detail = verify_checkpoint(ckpt.directory, epoch)
+        if ok is False:
+            monitor.inc("resilience/checkpoint_corrupt_total")
+            # PROVEN corrupt (digest/size mismatch): quarantine the
+            # step dir so the resumed run's save of this epoch really
+            # writes (orbax silently skips saves to an existing step)
+            # and nothing re-blesses the corrupt files.  Restore-raise
+            # failures below are NOT quarantined — without a digest
+            # proof the failure could be transient, and discarding a
+            # good checkpoint is worse than a skipped re-save.
+            quarantined = None
+            qfn = getattr(ckpt, "quarantine_epoch", None)
+            if qfn is not None:
+                try:
+                    quarantined = qfn(epoch)
+                except OSError:
+                    pass
+            print(f"[resilience] checkpoint epoch {epoch} in "
+                  f"{ckpt.directory} is CORRUPT ({detail}); "
+                  f"{'quarantined to ' + quarantined if quarantined else 'left in place'}"
+                  "; trying the previous kept epoch",
+                  file=sys.stderr, flush=True)
+            continue
+        try:
+            payload = ckpt.restore(epoch, like=like)
+        except Exception as e:
+            # unverifiable (no manifest) + unloadable, or a corruption
+            # the manifest missed — same fallback
+            monitor.inc("resilience/checkpoint_corrupt_total")
+            print(f"[resilience] checkpoint epoch {epoch} in "
+                  f"{ckpt.directory} failed to restore "
+                  f"({type(e).__name__}: {e}); trying the previous "
+                  "kept epoch", file=sys.stderr, flush=True)
+            continue
+        if i > 0:
+            monitor.inc("resilience/checkpoint_fallbacks_total")
+            print(f"[resilience] resumed from FALLBACK epoch {epoch} "
+                  f"(skipped {i} corrupt/unloadable)", file=sys.stderr,
+                  flush=True)
+        return epoch, payload
+    return None, None
+
+
+def latest_manifest_epoch(directory: str) -> int | None:
+    """Newest epoch with a manifest on disk — the cheap (digest-free)
+    resume hint used by :func:`record_crash`; full verification
+    happens at actual resume."""
+    import glob
+    import re
+
+    best = None
+    for path in glob.glob(os.path.join(directory, "manifest_*.json")):
+        m = re.search(r"manifest_(\d+)\.json$", path)
+        if m:
+            e = int(m.group(1))
+            best = e if best is None else max(best, e)
+    return best
+
+
+def record_crash(rule_name: str, exc: BaseException,
+                 model=None) -> str | None:
+    """The rule-session postmortem hook (rules/base.py): drop a
+    machine-readable crash marker with a resume hint into the monitor
+    run dir.  Never raises; no-op when monitoring is disabled."""
+    run_dir = monitor.monitor_dir()
+    if not monitor.enabled() or run_dir is None:
+        return None
+    try:
+        marker = {
+            "rule": rule_name,
+            "error": f"{type(exc).__name__}: {exc}",
+            "time": time.time(),
+        }
+        if model is not None:
+            ckpt_dir = os.path.join(model.config.snapshot_dir, model.name)
+            marker["checkpoint_dir"] = os.path.abspath(ckpt_dir)
+            marker["latest_manifest_epoch"] = (
+                latest_manifest_epoch(ckpt_dir)
+                if os.path.isdir(ckpt_dir) else None)
+        path = os.path.join(run_dir, f"resilience_crash_{os.getpid()}.json")
+        with open(path, "w") as f:
+            json.dump(marker, f)
+        return path
+    except Exception:
+        return None  # a crash marker must never mask the crash

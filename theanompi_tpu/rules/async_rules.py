@@ -19,8 +19,15 @@ controller process, each running its own jitted step on its own
 sub-mesh; server state lives on the host (parallel/server.py) and
 parameter traffic is XLA host<->device transfer.  Each worker trains
 on its own data shard (``shard_rank``/``shard_size``), like the
-reference's per-rank shard lists.  Failure semantics stay fail-fast:
-any worker exception aborts the session (SURVEY.md §5.3).
+reference's per-rank shard lists.  Failure semantics stay fail-fast by
+DEFAULT: any worker exception aborts the session (SURVEY.md §5.3).
+``max_restarts > 0`` opts into supervised recovery
+(resilience.supervisor / docs/RESILIENCE.md): a crashed EASGD/ASGD
+worker is restarted from the center params with a bounded budget; a
+crashed GOSGD worker (no center to restart from) is deactivated via
+the hub's existing path so peers stop gossiping at it; the session
+aborts only when the surviving-worker quorum (``min_workers``) is
+lost.
 """
 
 from __future__ import annotations
@@ -46,6 +53,8 @@ from theanompi_tpu.parallel.service import (
     RemoteGossipHub,
     ServiceClient,
 )
+from theanompi_tpu.resilience import faults
+from theanompi_tpu.resilience.supervisor import WorkerSupervisor
 from theanompi_tpu.rules.base import Rule, resolve_model_class
 from theanompi_tpu.utils.checkpoint import Checkpointer
 from theanompi_tpu.utils.helper_funcs import load_params_npz, save_params_npz
@@ -101,7 +110,15 @@ class _AsyncRule(Rule):
             kwargs.setdefault("data", m.data)
         return models
 
-    def _run_worker_threads(self, targets):
+    def _run_worker_threads(self, targets, extra=(), supervisor=None):
+        """Run worker targets (+ ``extra`` non-worker targets, e.g.
+        EASGD's orchestrator).  ``supervisor=None`` is the reference's
+        fail-fast path; a WorkerSupervisor applies bounded
+        restart-from-center / lose-with-quorum semantics to the
+        worker targets only."""
+        if supervisor is not None:
+            supervisor.run(targets, extra=extra)
+            return
         errors: list[BaseException] = []
         abort = threading.Event()
 
@@ -116,7 +133,8 @@ class _AsyncRule(Rule):
                                  name=f"{self.name}-worker{rank}")
             return t
 
-        threads = [wrap(fn, i) for i, fn in enumerate(targets)]
+        threads = [wrap(fn, i)
+                   for i, fn in enumerate(list(targets) + list(extra))]
         for t in threads:
             t.start()
         for t in threads:
@@ -134,7 +152,8 @@ class EASGD(_AsyncRule):
                  sync_type, tau: int = 10, alpha: float = 0.5,
                  max_epochs: int | None = None, checkpoint: bool = True,
                  server_addr: str | None = None,
-                 session_id: str | None = None, **kwargs):
+                 session_id: str | None = None,
+                 max_restarts: int = 0, min_workers: int = 1, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
         self.model = models[0]
@@ -147,10 +166,11 @@ class EASGD(_AsyncRule):
         if resume:
             if ckpt is None:
                 raise ValueError("resume=True requires checkpoint=True")
-            latest = ckpt.latest_epoch()
-            if latest is not None:
-                payload = ckpt.restore(latest, like={
-                    "state": models[0].state, "epoch": 0})
+            # integrity-checked: a corrupt latest checkpoint falls back
+            # to the previous kept epoch (resilience.recovery)
+            _, payload = ckpt.restore_latest_verified(like={
+                "state": models[0].state, "epoch": 0})
+            if payload is not None:
                 start_epoch = int(payload["epoch"]) + 1
                 center0 = jax.device_get(payload["state"].params)
                 for m in models:
@@ -183,6 +203,19 @@ class EASGD(_AsyncRule):
         self.server = server
         n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
                                                                start_epoch + max_epochs)
+        # supervised recovery (opt-in): a dead worker restarts from the
+        # CENTER params — the whole point of keeping an elastic center
+        sup = None
+        if max_restarts > 0:
+            def _restart_from_center(rank: int) -> None:
+                center = jax.tree.map(np.asarray, server.get_center())
+                models[rank].state = models[rank].state.replace(
+                    params=replicate(center, models[rank].mesh))
+
+            sup = WorkerSupervisor(
+                n_workers=len(models), max_restarts=max_restarts,
+                min_workers=min_workers,
+                restart_from=_restart_from_center, name=self.name)
         recorders = [Recorder(rank=i, size=len(devs),
                               print_freq=cfg.print_freq,
                               flops_per_sample=models[
@@ -193,17 +226,26 @@ class EASGD(_AsyncRule):
 
         def make_worker(rank: int):
             model, recorder = models[rank], recorders[rank]
+            # outlives one work() invocation: a supervised restart
+            # resumes at the epoch the worker died in — re-running
+            # completed epochs would retrain redundantly, and a
+            # restarted rank 0 would re-release epoch_done for epochs
+            # the orchestrator already validated
+            progress = {"epoch": start_epoch}
 
             def work(abort: threading.Event):
                 srv = connect()
                 try:
                     model.compile_iter_fns("avg")
                     it_total = 0
-                    for epoch in range(start_epoch, n_epochs):
+                    for epoch in range(progress["epoch"], n_epochs):
+                        progress["epoch"] = epoch
                         n_iters = model.begin_epoch(epoch)
                         for it in range(n_iters):
                             if abort.is_set():
                                 return
+                            faults.fire("worker_step", rule="easgd",
+                                        worker=rank, step=it_total)
                             t_it = time.monotonic()
                             if it_total % tau == 0:
                                 recorder.start()
@@ -217,10 +259,13 @@ class EASGD(_AsyncRule):
                             model.train_iter(it, recorder)
                             it_total += 1
                             # feeds the step histogram, heartbeat, and
-                            # the cross-worker straggler detector
-                            monitor.observe_step(
+                            # the cross-worker straggler detector —
+                            # whose flag the supervisor consumes
+                            flagged = monitor.observe_step(
                                 time.monotonic() - t_it, phase="train",
                                 step=it_total, worker=rank)
+                            if sup is not None:
+                                sup.note_straggler(rank, flagged)
                         model._flush_metrics(recorder)
                         model.adjust_hyperp(epoch + 1)
                         if rank == 0:
@@ -257,6 +302,12 @@ class EASGD(_AsyncRule):
                 while not epoch_done.acquire(timeout=0.5):
                     if abort.is_set():
                         return
+                    if sup is not None and sup.is_lost(0):
+                        # worker 0 drives this cadence; with it lost
+                        # (restarts exhausted, quorum held) there will
+                        # be no more epoch_done releases — stop
+                        # validating instead of spinning forever
+                        return
                 center = jax.tree.map(np.asarray, server.get_center())
                 val_model.state = val_model.state.replace(
                     params=replicate(center, val_model.mesh))
@@ -270,13 +321,17 @@ class EASGD(_AsyncRule):
 
         try:
             self._run_worker_threads(
-                [make_worker(i) for i in range(len(models))] + [orchestrate])
+                [make_worker(i) for i in range(len(models))],
+                extra=[orchestrate], supervisor=sup)
             self.result = {
                 "val": val_results[-1] if val_results else {},
                 "val_curve": val_results,
                 "n_exchanges": server.n_exchanges,
                 "center": server.get_center(),
             }
+            if sup is not None:
+                self.result["restarts"] = sup.restart_counts()
+                self.result["lost_workers"] = sup.lost_workers()
         finally:
             if ckpt is not None:
                 ckpt.close()
@@ -292,7 +347,8 @@ class ASGD(_AsyncRule):
     def _session(self, devs, modelfile, modelclass, config, resume,
                  sync_type, max_epochs: int | None = None,
                  checkpoint: bool = True, server_addr: str | None = None,
-                 session_id: str | None = None, **kwargs):
+                 session_id: str | None = None,
+                 max_restarts: int = 0, min_workers: int = 1, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
         self.model = models[0]
@@ -309,10 +365,9 @@ class ASGD(_AsyncRule):
         if resume:
             if ckpt is None:
                 raise ValueError("resume=True requires checkpoint=True")
-            latest = ckpt.latest_epoch()
-            if latest is not None:
-                payload = ckpt.restore(latest, like={
-                    "state": models[0].state, "epoch": 0})
+            _, payload = ckpt.restore_latest_verified(like={
+                "state": models[0].state, "epoch": 0})
+            if payload is not None:
                 start_epoch = int(payload["epoch"]) + 1
                 center0 = jax.device_get(payload["state"].params)
                 restored_opt = jax.device_get(payload["state"].opt_state)
@@ -348,6 +403,17 @@ class ASGD(_AsyncRule):
             server.set_lr(models[0].adjust_hyperp(start_epoch))
         n_epochs = cfg.n_epochs if max_epochs is None else min(
             cfg.n_epochs, start_epoch + max_epochs)
+        sup = None
+        if max_restarts > 0:
+            def _restart_from_center(rank: int) -> None:
+                center = jax.tree.map(np.asarray, server.get_center())
+                models[rank].state = models[rank].state.replace(
+                    params=replicate(center, models[rank].mesh))
+
+            sup = WorkerSupervisor(
+                n_workers=len(models), max_restarts=max_restarts,
+                min_workers=min_workers,
+                restart_from=_restart_from_center, name=self.name)
         recorders = [Recorder(rank=i, size=len(devs),
                               print_freq=cfg.print_freq,
                               flops_per_sample=models[
@@ -357,16 +423,26 @@ class ASGD(_AsyncRule):
 
         def make_worker(rank: int):
             model, recorder = models[rank], recorders[rank]
+            # supervised restarts resume at the crash epoch: re-running
+            # from start_epoch would retrain redundantly AND (rank 0)
+            # re-push the EARLY-schedule LR to the server via set_lr,
+            # snapping the surviving workers' global LR backwards
+            progress = {"epoch": start_epoch}
 
             def work(abort: threading.Event):
                 srv = connect()
                 try:
                     gstep = model.compile_grad_fn()
-                    for epoch in range(start_epoch, n_epochs):
+                    it_total = 0
+                    for epoch in range(progress["epoch"], n_epochs):
+                        progress["epoch"] = epoch
                         n_iters = model.begin_epoch(epoch)
                         for it in range(n_iters):
                             if abort.is_set():
                                 return
+                            faults.fire("worker_step", rule="asgd",
+                                        worker=rank, step=it_total)
+                            it_total += 1
                             t_it = time.monotonic()
                             recorder.start()
                             batch = next(model._train_iter)
@@ -386,9 +462,11 @@ class ASGD(_AsyncRule):
                             recorder.train_metrics(float(metrics["loss"]),
                                                    float(metrics["error"]),
                                                    model.global_batch)
-                            monitor.observe_step(
+                            flagged = monitor.observe_step(
                                 time.monotonic() - t_it, phase="train",
                                 step=it, worker=rank)
+                            if sup is not None:
+                                sup.note_straggler(rank, flagged)
                         new_lr = model.adjust_hyperp(epoch + 1)
                         if rank == 0:
                             # the server's optimizer applies the updates,
@@ -404,6 +482,12 @@ class ASGD(_AsyncRule):
                             # test_asgd_lr_schedule_reaches_server).
                             srv.set_lr(new_lr)
                             if ckpt is not None:
+                                # a restarted rank 0 re-reaching an
+                                # epoch it saved pre-crash: orbax
+                                # silently skips the duplicate save
+                                # (the pre-crash checkpoint of that
+                                # epoch stands; force=True would
+                                # REFUSE, not overwrite, on orbax 0.7)
                                 ckpt.save(epoch, {
                                     "state": model.state.replace(
                                         params=jax.device_get(
@@ -422,7 +506,8 @@ class ASGD(_AsyncRule):
 
         try:
             self._run_worker_threads(
-                [make_worker(i) for i in range(len(models))])
+                [make_worker(i) for i in range(len(models))],
+                supervisor=sup)
             center = jax.device_get(server.get_center())
             n_updates = server.n_updates
         finally:
@@ -436,6 +521,9 @@ class ASGD(_AsyncRule):
         val = probe.val_epoch(recorders[0])
         self.result = {"val": val, "n_updates": n_updates,
                        "center": center}
+        if sup is not None:
+            self.result["restarts"] = sup.restart_counts()
+            self.result["lost_workers"] = sup.lost_workers()
 
 
 class GOSGD(_AsyncRule):
@@ -451,7 +539,8 @@ class GOSGD(_AsyncRule):
                  n_total_workers: int | None = None,
                  rank_offset: int = 0,
                  session_id: str | None = None,
-                 merge_momentum: str = "scale", **kwargs):
+                 merge_momentum: str = "scale",
+                 max_restarts: int = 0, min_workers: int = 1, **kwargs):
         if merge_momentum not in ("scale", "keep"):
             raise ValueError(f"merge_momentum must be 'scale' or 'keep', "
                              f"got {merge_momentum!r}")
@@ -502,10 +591,9 @@ class GOSGD(_AsyncRule):
         if resume:
             if ckpt is None:
                 raise ValueError("resume=True requires checkpoint=True")
-            latest = ckpt.latest_epoch()
-            if latest is not None:
-                payload = ckpt.restore(latest, like={
-                    "state": models[0].state, "epoch": 0})
+            latest, payload = ckpt.restore_latest_verified(like={
+                "state": models[0].state, "epoch": 0})
+            if payload is not None:
                 start_epoch = int(payload["epoch"]) + 1
                 meta_path = os.path.join(sidecar_dir,
                                          f"gosgd_meta_{latest}.json")
@@ -541,6 +629,18 @@ class GOSGD(_AsyncRule):
                     m.adjust_hyperp(start_epoch)
         n_epochs = cfg.n_epochs if max_epochs is None else min(
             cfg.n_epochs, start_epoch + max_epochs)
+        # GOSGD supervision: there is NO center to restart a dead
+        # worker from — a failed worker falls back to the hub's
+        # existing deactivate path (peers stop pushing to the corpse,
+        # conserving gossip weight); the session aborts only when the
+        # quorum is lost (docs/RESILIENCE.md)
+        sup = None
+        if max_restarts > 0:
+            sup = WorkerSupervisor(
+                n_workers=n, max_restarts=0, min_workers=min_workers,
+                restart_from=None,
+                on_lost=lambda rank: hub.deactivate(rank),
+                name=self.name)
 
         def make_worker(rank: int):
             model, recorder = models[rank], recorders[rank]
@@ -558,11 +658,15 @@ class GOSGD(_AsyncRule):
 
             def gosgd_loop(h, abort):
                 model.compile_iter_fns("avg")
+                it_total = 0
                 for epoch in range(start_epoch, n_epochs):
                     n_iters = model.begin_epoch(epoch)
                     for it in range(n_iters):
                         if abort.is_set():
                             return
+                        faults.fire("worker_step", rule="gosgd",
+                                    worker=g_rank, step=it_total)
+                        it_total += 1
                         t_it = time.monotonic()
                         # merge anything gossiped to us
                         recorder.start()
@@ -599,9 +703,11 @@ class GOSGD(_AsyncRule):
                                 if h.push(dst, model.state.params, half):
                                     weights[rank] = half
                             recorder.end("comm")
-                        monitor.observe_step(
+                        flagged = monitor.observe_step(
                             time.monotonic() - t_it, phase="train",
                             step=it, worker=rank)
+                        if sup is not None:
+                            sup.note_straggler(rank, flagged)
                     model._flush_metrics(recorder)
                     model.adjust_hyperp(epoch + 1)
                     if ckpt is not None:
@@ -631,7 +737,8 @@ class GOSGD(_AsyncRule):
             return work
 
         try:
-            self._run_worker_threads([make_worker(i) for i in range(n)])
+            self._run_worker_threads([make_worker(i) for i in range(n)],
+                                     supervisor=sup)
             # merge whatever was still in flight at shutdown (conserves
             # the gossip weight), then fold the weighted consensus
             for rank in range(n):
@@ -664,3 +771,5 @@ class GOSGD(_AsyncRule):
         val = probe.val_epoch(recorders[0])
         self.result = {"val": val, "weights": weights,
                        "consensus": jax.tree.map(np.asarray, consensus)}
+        if sup is not None:
+            self.result["lost_workers"] = sup.lost_workers()

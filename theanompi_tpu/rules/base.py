@@ -145,8 +145,24 @@ class Rule:
                 from theanompi_tpu import monitor
 
                 with monitor.session(rank=jax.process_index()):
-                    self._session(devs, modelfile, modelclass, config,
-                                  resume, sync_type, **kwargs)
+                    try:
+                        self._session(devs, modelfile, modelclass, config,
+                                      resume, sync_type, **kwargs)
+                    except BaseException as e:
+                        try:
+                            # resilience postmortem hook: a machine-
+                            # readable crash marker + resume hint
+                            # beside the monitor's postmortem dump
+                            # (no-op when monitoring is off); must run
+                            # INSIDE the session while telemetry is
+                            # still live
+                            from theanompi_tpu.resilience import recovery
+
+                            recovery.record_crash(self.name, e,
+                                                  model=self.model)
+                        except Exception:
+                            pass
+                        raise
             except BaseException as e:  # propagated by wait()
                 traceback.print_exc()
                 self._error = e

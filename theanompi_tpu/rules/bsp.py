@@ -57,10 +57,12 @@ def run_bsp_session(model: TpuModel, sync_type: str = "avg",
         if checkpoint:
             ckpt = Checkpointer(os.path.join(cfg.snapshot_dir, model.name))
             if resume:
-                latest = ckpt.latest_epoch()
-                if latest is not None:
-                    payload = ckpt.restore(latest, like={
-                        "state": model.state, "epoch": 0})
+                # integrity-checked resume (resilience.recovery): a
+                # corrupt latest checkpoint falls back to the previous
+                # kept epoch instead of killing the restart
+                _, payload = ckpt.restore_latest_verified(like={
+                    "state": model.state, "epoch": 0})
+                if payload is not None:
                     # re-establish the model's sharding (a TP model would
                     # otherwise train on replicated restored arrays)
                     model.state = model.adopt_restored_state(

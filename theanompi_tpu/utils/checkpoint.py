@@ -10,16 +10,29 @@ is a valid init for any other — we store one canonical pytree
 ``{params, opt_state, model_state, epoch, step}``; EASGD saves its
 center params in the same slot, so an EASGD center checkpoint restores
 cleanly into a BSP run and vice versa.
+
+Integrity (docs/RESILIENCE.md): every *completed* save gets a
+``manifest_{epoch}.json`` beside its step directory (per-file sizes +
+sha256, queued at fence time — after the async write has landed — and
+digested on a background worker so the training thread never pays the
+hash);
+``restore_latest_verified`` restores the newest checkpoint that passes
+verification, falling back to older kept epochs when the latest is
+corrupt — a truncated checkpoint costs one epoch, not the resume.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from theanompi_tpu.resilience import faults, recovery
+from theanompi_tpu.resilience.retry import RetryPolicy
 
 PyTree = Any
 
@@ -36,27 +49,114 @@ class Checkpointer:
     per-epoch semantics."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, integrity: bool = True,
+                 retry: RetryPolicy | None = None):
         self.directory = os.path.abspath(directory)
         self.async_save = async_save
+        self.integrity = integrity
+        # transient-I/O retry on the RESTORE read path (a shared-
+        # filesystem hiccup must not kill a resume).  Deliberately NOT
+        # used around wait_until_finished: orbax clears its stored
+        # async-write exception after raising it once, so a retried
+        # fence would report a failed write as success — the exact
+        # data-loss masking the fence exists to prevent.
+        self._retry = retry or RetryPolicy(
+            max_attempts=3, base_delay=0.2, max_delay=2.0,
+            name="checkpoint_restore")
+        #: epochs saved but whose manifest is not yet written (the
+        #: async write may still be in flight)
+        self._unverified: set[int] = set()
+        # manifest digests run on a background worker (sha256 of a
+        # full checkpoint is seconds at ResNet scale — not something
+        # the training thread pays per epoch); drained only where
+        # manifests are actually consumed (restore_latest_verified,
+        # close, sync-mode save)
+        import queue as _queue
+
+        self._manifest_q: _queue.Queue = _queue.Queue()
+        self._manifest_thread: threading.Thread | None = None
+        self._max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
+        self._mgr = self._make_manager()
+
+    def _make_manager(self) -> ocp.CheckpointManager:
+        return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=self._max_to_keep, create=True
             ),
         )
 
     def _fence(self) -> None:
         """Join any in-flight background write, surfacing its error
         with checkpoint context (an async write failure otherwise
-        reads like an unrelated crash at the next epoch)."""
+        reads like an unrelated crash at the next epoch); then queue
+        integrity manifests for every write that just landed."""
         try:
             self._mgr.wait_until_finished()
         except Exception as e:
             raise RuntimeError(
                 f"background checkpoint write to {self.directory} "
                 f"failed: {e}") from e
+        if self.integrity:
+            self._sync_manifests()
+
+    def _sync_manifests(self) -> None:
+        """Queue manifest digests for completed saves; prune manifests
+        of epochs ``max_to_keep`` dropped.  Runs after a successful
+        fence, so every step dir queued here is fully written."""
+        kept = set(self._mgr.all_steps())
+        for epoch in sorted(self._unverified):
+            self._unverified.discard(epoch)
+            if epoch not in kept:
+                continue  # already pruned
+            step_dir = recovery.find_step_dir(self.directory, epoch)
+            if step_dir is not None:
+                self._manifest_q.put((epoch, step_dir))
+                self._ensure_manifest_worker()
+        recovery.prune_manifests(self.directory, kept)
+
+    def _ensure_manifest_worker(self) -> None:
+        if (self._manifest_thread is None
+                or not self._manifest_thread.is_alive()):
+            self._manifest_thread = threading.Thread(
+                target=self._manifest_loop, daemon=True,
+                name="checkpoint-manifests")
+            self._manifest_thread.start()
+
+    def _manifest_loop(self) -> None:
+        while True:
+            item = self._manifest_q.get()
+            if item is None:  # close() sentinel
+                self._manifest_q.task_done()
+                return
+            epoch, step_dir = item
+            try:
+                recovery.write_manifest(self.directory, epoch, step_dir)
+                # fault plane: corrupt the epoch AFTER its manifest is
+                # written from the good files — the bit-rot simulation
+                # the recovery tests drive (docs/RESILIENCE.md)
+                if faults.fire("checkpoint", epoch=epoch) == "truncate":
+                    _truncate_largest_file(step_dir)
+            except OSError:
+                pass  # a full disk must not kill anything
+            except Exception as e:
+                # the worker must survive ANYTHING (incl. a fault spec
+                # with a 'raise' action at this site) — a dead worker
+                # would hang _drain_manifests' Queue.join forever
+                import sys
+
+                print(f"[resilience] manifest worker: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
+            finally:
+                self._manifest_q.task_done()
+
+    def _drain_manifests(self) -> None:
+        """Block until every queued manifest is on disk — called where
+        manifests are consumed, never on the per-epoch save path."""
+        if self.integrity:
+            self._manifest_q.join()
 
     def save(self, epoch: int, payload: PyTree, force: bool = False) -> None:
         self._fence()  # fence any in-flight write
@@ -83,9 +183,22 @@ class Checkpointer:
             return np.array(l)
 
         payload = jax.tree.map(snap, payload)
+        # orbax 0.7: saving an already-existing step is SILENTLY
+        # skipped (and force=True refuses outright) — happens when a
+        # supervised restart re-reaches an epoch it saved pre-crash.
+        # A skipped save must not be queued for a manifest, or the
+        # fence would re-bless whatever files are already on disk.
+        skipped = int(epoch) in set(self._mgr.all_steps())
         self._mgr.save(epoch, args=ocp.args.StandardSave(payload), force=force)
+        if not skipped:
+            self._unverified.add(int(epoch))
         if not self.async_save:
+            # the reference's fully-synchronous semantics: write AND
+            # manifest are on disk when save returns
             self._mgr.wait_until_finished()
+            if self.integrity:
+                self._sync_manifests()
+                self._drain_manifests()
 
     def latest_epoch(self) -> int | None:
         self._fence()
@@ -110,8 +223,56 @@ class Checkpointer:
                 lambda l: l if (isinstance(l, jax.Array)
                                 and not l.is_fully_addressable)
                 else np.asarray(l), like)
-            return self._mgr.restore(epoch, args=ocp.args.StandardRestore(like))
-        return self._mgr.restore(epoch)
+            # transient read-I/O retry (resilience.retry): a shared-FS
+            # hiccup retries; a corrupt checkpoint (ValueError & co.)
+            # fails fast for restore_latest_verified's fallback
+            return self._retry.call(
+                self._mgr.restore, epoch,
+                args=ocp.args.StandardRestore(like),
+                site="checkpoint/restore")
+        return self._retry.call(self._mgr.restore, epoch,
+                                site="checkpoint/restore")
+
+    def quarantine_epoch(self, epoch: int) -> str | None:
+        """Move a PROVEN-corrupt epoch's step dir (and manifest) aside
+        so (a) the resumed run's save of that epoch actually writes —
+        orbax silently skips (or, with force, refuses) a save to an
+        existing step — and (b) no later manifest pass re-blesses the
+        corrupt files.  Recreates the manager so its step cache
+        forgets the quarantined epoch.  Returns the quarantine path
+        (None when there was nothing to move)."""
+        step_dir = recovery.find_step_dir(self.directory, epoch)
+        if step_dir is None:
+            return None
+        # a SUBDIRECTORY, not a sibling rename: orbax's step scanner
+        # parses trailing digits out of top-level names (corrupt_1
+        # would still read as step 1 and crash the manager's scan)
+        qdir = os.path.join(self.directory, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, str(int(epoch)))
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(qdir, f"{int(epoch)}.{n}")
+        os.rename(step_dir, dst)
+        mpath = recovery.manifest_path(self.directory, epoch)
+        try:
+            os.unlink(mpath)
+        except OSError:
+            pass
+        self._mgr.close()
+        self._mgr = self._make_manager()
+        return dst
+
+    def restore_latest_verified(self, like: PyTree | None = None
+                                ) -> tuple[int | None, PyTree | None]:
+        """(epoch, payload) of the newest checkpoint that verifies
+        against its manifest AND restores; falls back to older kept
+        epochs when the latest is corrupt (resilience.recovery).
+        (None, None) when nothing is restorable."""
+        self._fence()
+        self._drain_manifests()  # verification consumes the manifests
+        return recovery.restore_latest_verified(self, like=like)
 
     def close(self) -> None:
         # A failed final write is itself data loss — surface it.  When
@@ -119,4 +280,24 @@ class Checkpointer:
         # Python's implicit chaining keeps BOTH visible ('during
         # handling of the above exception...'), so nothing is masked.
         self._fence()
+        self._drain_manifests()  # manifests must outlive this process
+        if (self._manifest_thread is not None
+                and self._manifest_thread.is_alive()):
+            self._manifest_q.put(None)  # release the worker thread
+            self._manifest_thread.join(timeout=5)
         self._mgr.close()
+
+
+def _truncate_largest_file(step_dir: str) -> None:
+    """Fault-plane helper: halve the largest file in a step dir (the
+    'checkpoint write landed corrupt' simulation)."""
+    best, best_size = None, -1
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            size = os.path.getsize(full)
+            if size > best_size:
+                best, best_size = full, size
+    if best is not None and best_size > 0:
+        with open(best, "r+b") as f:
+            f.truncate(best_size // 2)

@@ -64,7 +64,48 @@ PYEOF
   MONITOR_RC=$?
   rm -rf "$MONDIR"
   echo "monitor smoke rc=$MONITOR_RC"
-  if [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ]; then
+  echo "## resilience smoke (EASGD kill-and-recover via THEANOMPI_TPU_FAULTS)"
+  # fault-injection end-to-end (docs/RESILIENCE.md): kill worker 1 at
+  # step 3 of a tiny EASGD session; supervised recovery must restart
+  # it from center, the run must exit 0, and the recovery event must
+  # land in the monitor JSONL
+  FAULTDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$FAULTDIR" \
+    THEANOMPI_TPU_FAULTS='[{"site": "worker_step", "rule": "easgd", "worker": 1, "step": 3}]' \
+    python - <<'PYEOF'
+import json, os
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from theanompi_tpu import EASGD
+from theanompi_tpu.models.base import ModelConfig
+
+cfg = ModelConfig(batch_size=8, n_epochs=1, learning_rate=0.01,
+                  snapshot_dir=os.environ["THEANOMPI_TPU_MONITOR"],
+                  print_freq=0)
+rule = EASGD()
+rule.init(devices=2, modelfile="tests._tiny_models",
+          modelclass="TinyCifar", config=cfg, tau=4, alpha=0.5,
+          checkpoint=False, max_restarts=1)
+res = rule.wait()
+assert res["restarts"] == {1: 1}, res.get("restarts")
+assert res["lost_workers"] == [], res.get("lost_workers")
+assert np.isfinite(res["val"]["loss"])
+mondir = os.environ["THEANOMPI_TPU_MONITOR"]
+recs = [json.loads(l)
+        for l in open(os.path.join(mondir, "metrics_rank0.jsonl"))]
+by_name = {r["name"]: r for r in recs}
+assert "resilience/worker_restarts_total" in by_name, sorted(by_name)
+assert "resilience/faults_injected_total" in by_name
+print("resilience smoke OK: worker 1 killed at step 3, restarted "
+      "from center, recovery event in monitor JSONL")
+PYEOF
+  RESILIENCE_RC=$?
+  rm -rf "$FAULTDIR"
+  echo "resilience smoke rc=$RESILIENCE_RC"
+  if [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     exit 1
   fi
