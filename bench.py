@@ -397,7 +397,11 @@ def _main() -> int:
         k = 1   # scanned bodies are ~13x slower on the CPU backend
     cfg = ModelConfig(batch_size=batch_per_chip, n_epochs=1,
                       compute_dtype="bfloat16", track_top5=False,
-                      steps_per_call=k, print_freq=10**9)
+                      steps_per_call=k, print_freq=10**9,
+                      # the device-step leg replays 2 pre-staged
+                      # batches round-robin; donation would delete
+                      # them after the first pass
+                      donate_batch=False)
     model = BenchResNet50(config=cfg, mesh=mesh, verbose=False)
     model.compile_iter_fns("avg")
 

@@ -66,6 +66,30 @@ def test_accum_matches_big_batch(mesh8):
     assert int(s_acc.step) == 1  # ONE optimizer update
 
 
+def test_accum_donates_staged_batch(mesh8):
+    """The accum cadence donates the stacked microbatch buffers like
+    the multi-step one (ISSUE 3 copy-done fix); the opt-out withholds
+    exactly the two batch leaves for batch-replaying callers."""
+    from jax.sharding import PartitionSpec as P
+
+    from tests.test_multi_step import _donated_inputs
+    from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+    tx, state0, x, y = _setup(mesh8)
+    stacked_np = (x.reshape(4, 16, 4), y.reshape(4, 16))
+
+    def donors(**kw):
+        accum = make_bsp_accum_step(_linreg_loss, tx, mesh8, **kw)
+        stacked = shard_batch(stacked_np, mesh8, spec=P(None, AXIS_DATA))
+        lowered = accum.lower(
+            TrainState.create({"w": jnp.arange(4.0)}, tx), stacked,
+            jax.random.key(0))
+        return _donated_inputs(lowered.as_text())
+
+    assert donors() == donors(donate_batch=False) + 2
+    assert donors(donate=False) == 0
+
+
 def test_accum_rejects_param_averaging(mesh8):
     tx, _, _, _ = _setup(mesh8)
     with pytest.raises(ValueError, match="exchange_what='grads'"):

@@ -205,6 +205,137 @@ class TestDeepdive:
         assert rep["copy_done_by_size_class"][0]["key"] == "param_vec"
 
 
+from analyze_xplane import attribute_copies, copy_endpoints  # noqa: E402
+
+# real v5e copy-done text shapes from the r3 capture: a param-vector
+# prefetch INTO the alternate memory space (dest S(1)), a big
+# activation written back OUT of it (src S(1)), and a space-less move
+CD_PREFETCH = ("%copy-done.1261 = f32[64]{0:T(128)S(1)} copy-done(("
+               "f32[64]{0:T(128)S(1)}, f32[64]{0:T(128)}, u32[]{:S(2)})"
+               " %copy-start.1261)")
+CD_WRITEBACK = ("%copy-done.27 = bf16[128,224,224,3]{0,2,3,1:T(8,128)"
+                "(2,1)} copy-done((bf16[128,224,224,3]{0,2,3,1:T(8,128)"
+                "(2,1)}, bf16[128,224,224,3]{0,2,3,1:T(8,128)(2,1)S(1)}"
+                ", u32[]{:S(2)}) %copy-start.27)")
+CD_MOVE = ("%copy-done.9 = s32[128]{0:T(128)} copy-done((s32[128]"
+           "{0:T(128)}, s32[128]{0:T(128)}, u32[]{:S(2)}) "
+           "%copy-start.9)")
+
+
+class TestCopyAttribution:
+    def test_endpoints_direction_and_bytes(self):
+        d, shape, _lay, nbytes = copy_endpoints(CD_PREFETCH)
+        assert (d, shape, nbytes) == ("prefetch", "f32[64]", 256)
+        d, shape, _lay, nbytes = copy_endpoints(CD_WRITEBACK)
+        assert d == "writeback" and shape == "bf16[128,224,224,3]"
+        assert nbytes == 128 * 224 * 224 * 3 * 2
+        assert copy_endpoints(CD_MOVE)[0] == "move"
+        assert copy_endpoints("%f = f32[8]{0} fusion(...)")[0] \
+            == "unknown"
+
+    def test_attribution_rows_and_totals(self):
+        events = [
+            _ev(CD_PREFETCH, "copy-done", 0.002) for _ in range(6)
+        ] + [
+            _ev(CD_WRITEBACK, "copy-done", 0.4),
+            _ev(CD_MOVE, "copy-done", 0.01),
+            _ev("%cs = ... copy-start(...)", "copy-start", 0.001),
+            _ev(FPROP, "convolution fusion", 2.0),   # ignored
+        ]
+        rep = attribute_copies(events, n_steps=2)
+        assert rep["copy_done_events_per_step"] == 4  # 8 // 2
+        assert rep["copy_done_ms_per_step"] == pytest.approx(
+            (6 * 0.002 + 0.4 + 0.01) / 2, abs=1e-6)
+        assert rep["copy_start_events_per_step"] == 0  # 1 // 2
+        top = rep["rows"][0]
+        assert top["producer"] == \
+            "writeback:activation:bf16[128,224,224,3]"
+        assert top["ms_per_step"] == pytest.approx(0.2)
+        assert top["pct_of_copy_done"] == pytest.approx(
+            100 * 0.4 / 0.422, abs=0.1)
+        by_key = {r["producer"]: r for r in rep["rows"]}
+        pv = by_key["prefetch:param_vec:f32[64]"]
+        assert pv["events_per_step"] == 3
+        assert pv["us_per_event"] == pytest.approx(2.0)
+        assert "move:param_vec:s32[128]" in by_key
+
+    def test_empty_capture(self):
+        rep = attribute_copies([], n_steps=1)
+        assert rep["rows"] == [] and rep["copy_done_ms_per_step"] == 0
+
+
+from xla_sweep import SWEEPS, ab_report, build_entries  # noqa: E402
+
+
+class TestXlaSweep:
+    def test_entries_are_queue_ready(self):
+        entries = build_entries()
+        names = [e[0] for e in entries]
+        # flags x models throughput points + the A/B profile pair
+        assert "sweep_resnet_k4_b128_lhs" in names
+        assert "resnet_ab_before_profile" in names
+        assert "resnet_ab_after_fused_profile" in names
+        for name, argv, timeout in entries:
+            assert isinstance(name, str) and isinstance(timeout, int)
+            assert isinstance(argv, list) and len(argv) >= 2
+        ab = dict((e[0], e[1]) for e in entries)
+        after = ab["resnet_ab_after_fused_profile"]
+        assert "--bn-act-impl" in after and "pallas" in after
+        before = ab["resnet_ab_before_profile"]
+        assert "--bn-act-impl" not in before
+        # every non-base sweep entry carries its flags
+        lhs = ab["sweep_resnet_k4_b128_lhs"]
+        assert "--xla-flags" in lhs
+        assert SWEEPS["lhs"] in lhs
+
+    def test_entries_respect_config_override(self):
+        entries = build_entries(sweeps={"only": "--xla_foo=1"})
+        names = [e[0] for e in entries]
+        assert "sweep_resnet_k4_b128_only" in names
+        assert not any("_lhs" in n for n in names)
+
+    def test_ab_report_deltas(self):
+        def account(conv, copy, copy_rows):
+            return {
+                "report": {
+                    "totals": {"device_busy_ms_per_step": conv + copy},
+                    "categories": {
+                        "convolution fusion": {
+                            "ms_per_step": conv, "events_per_step": 10},
+                        "copy-done": {
+                            "ms_per_step": copy,
+                            "events_per_step": 100},
+                    },
+                },
+                "copy_attribution": {
+                    "copy_done_ms_per_step": copy,
+                    "rows": [
+                        {"producer": k, "ms_per_step": v}
+                        for k, v in copy_rows.items()],
+                },
+            }
+
+        before = account(36.9, 2.4, {"prefetch:param_vec:f32[64]": 1.4,
+                                     "writeback:activation:x": 1.0})
+        after = account(36.9, 1.5, {"prefetch:param_vec:f32[64]": 1.4,
+                                    "writeback:activation:x": 0.1})
+        rep = ab_report(before, after)
+        assert rep["totals"]["delta_ms"] == pytest.approx(-0.9)
+        assert rep["categories"]["copy-done"]["delta_ms"] == \
+            pytest.approx(-0.9)
+        assert rep["categories"]["convolution fusion"]["delta_ms"] == 0
+        assert rep["copy_producers"]["writeback:activation:x"][
+            "delta_ms"] == pytest.approx(-0.9)
+        assert rep["copy_totals"]["delta_ms"] == pytest.approx(-0.9)
+
+    def test_ab_report_accepts_bare_reports(self):
+        bare = {"totals": {"device_busy_ms_per_step": 10.0},
+                "categories": {"loop fusion": {"ms_per_step": 5.0}}}
+        rep = ab_report(bare, bare)
+        assert rep["totals"]["delta_ms"] == 0.0
+        assert "copy_producers" not in rep
+
+
 class TestPickNSteps:
     def test_prefers_xla_modules(self):
         assert pick_n_steps({"XLA Modules": 5, "Steps": 7}) == 5

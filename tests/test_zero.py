@@ -173,6 +173,34 @@ def test_zero_multi_step_equals_singles(mesh8):
     assert int(s_m.step) == k
 
 
+def test_zero_stacked_cadence_donates_staged_batch(mesh8):
+    """ISSUE 3 copy-done fix reaches the ZeRO cadences too: the
+    multi-step lowering donates the two batch leaves on top of the
+    state, and donate_batch=False withholds exactly those two."""
+    from jax.sharding import PartitionSpec as P
+
+    from tests.test_multi_step import _donated_inputs
+
+    tx = build_optimizer(0.05, optimizer="sgd", momentum=0.9)
+    params = _params()
+    rng_np = np.random.default_rng(9)
+    x = rng_np.standard_normal((2, 16, 5)).astype(np.float32)
+    y = rng_np.standard_normal((2, 16, 3)).astype(np.float32)
+
+    def donors(**kw):
+        zm = make_bsp_zero_step(_loss, tx, mesh8, params, multi=True,
+                                **kw)
+        opt0, _ = init_zero_opt_state(tx, params, mesh8)
+        s = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt0, model_state={})
+        stacked = shard_batch((x, y), mesh8, spec=P(None, AXIS_DATA))
+        return _donated_inputs(
+            zm.lower(s, stacked, jax.random.key(0)).as_text())
+
+    assert donors() == donors(donate_batch=False) + 2
+    assert donors(donate=False) == 0
+
+
 def test_zero_steps_per_call_model_glue(mesh8):
     """The model path (stacked host batches -> train_step_multi) works
     with a SHARDED optimizer state."""

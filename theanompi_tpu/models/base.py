@@ -135,6 +135,26 @@ class ModelConfig:
     #: bytes from the MFU account; flip per-recipe only after
     #: tools/bench_maxpool.py confirms on chip)
     pool_impl: str = "xla"
+    #: BN/activation epilogue impl: 'xla' (today's unfused composition,
+    #: default) or 'pallas' (ops/fused_bn.py — ONE stream for the BN
+    #: affine + residual add + relu, targeting the account's 5.81 ms of
+    #: loop-fusion HBM traffic).  ResNet family: fuses every
+    #: BN(+add)+relu with the param tree unchanged.  BN-free models
+    #: (VGG/GoogLeNet) route their conv bias+relu epilogues through
+    #: layers.BiasAct instead — NOTE that moves the bias param out of
+    #: the conv scope, so their param tree depends on this knob (pick
+    #: it at build time, not mid-run).  Default-off until the queued
+    #: A/B account pair (tools/xla_sweep.py) confirms on chip.
+    bn_act_impl: str = "xla"
+    #: donate the STAGED BATCH buffers to the stacked-cadence steps
+    #: (steps_per_call / grad_accum_steps programs) so XLA reuses their
+    #: HBM for outputs instead of copying around live input buffers —
+    #: part of the copy-done attack (the r3 account counts 1 334
+    #: copy events/step).  The prefetcher stages a fresh batch per
+    #: dispatch, so donation is safe on the training path; turn off
+    #: when replaying the SAME staged batch through a step twice
+    #: (bench.py's pre-staged device-step leg does)
+    donate_batch: bool = True
     #: cross-replica BatchNorm: compute BN batch statistics over the
     #: whole DATA axis (lax.pmean inside the BN, flax ``axis_name``)
     #: instead of per-shard.  The standard TPU-pod choice when the
@@ -550,6 +570,7 @@ class TpuModel:
             # param_specs was derived at state build; passing it keeps
             # the step's shardings and the resume placement identical
             fsdp_kw = dict(avg=(sync_type != "cdd"), batch_partition=part,
+                           donate_batch=self.config.donate_batch,
                            specs=self.param_specs)
             self.train_step = make_bsp_fsdp_step(
                 self.loss_fn, self.tx, self.mesh,
@@ -575,6 +596,7 @@ class TpuModel:
 
             self._check_zero_supported()
             zero_kw = dict(avg=(sync_type != "cdd"),
+                           donate_batch=self.config.donate_batch,
                            batch_partition=part, reduce_axes=axes)
             self.train_step = make_bsp_zero_step(
                 self.loss_fn, self.tx, self.mesh,
@@ -609,12 +631,14 @@ class TpuModel:
 
             self.train_step_multi = make_bsp_multi_step(
                 self.loss_fn, self.tx, self.mesh, exchanger,
+                donate_batch=self.config.donate_batch,
                 batch_partition=part, reduce_axes=axes)
         if self.config.grad_accum_steps > 1:
             from theanompi_tpu.parallel.bsp import make_bsp_accum_step
 
             self.train_step_accum = make_bsp_accum_step(
                 self.loss_fn, self.tx, self.mesh, exchanger,
+                donate_batch=self.config.donate_batch,
                 batch_partition=part, reduce_axes=axes)
         self.eval_step = make_bsp_eval_step(self.eval_fn, self.mesh,
                                             batch_partition=part,

@@ -31,13 +31,23 @@ class ConvRelu(nn.Module):
     strides: tuple[int, int] = (1, 1)
     padding: str = "SAME"
     dtype: jnp.dtype = jnp.float32
+    #: bias+relu epilogue (ModelConfig.bn_act_impl): 'pallas' fuses
+    #: them via layers.BiasAct; moves the bias param out of the conv
+    #: scope (see layers.BiasAct)
+    act_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
+        if self.act_impl == "xla":
+            x = L.Conv(self.features, self.kernel, strides=self.strides,
+                       padding=self.padding, kernel_init=L.xavier_init(),
+                       bias_init=L.constant_init(0.2), dtype=self.dtype)(x)
+            return nn.relu(x)
         x = L.Conv(self.features, self.kernel, strides=self.strides,
-                   padding=self.padding, kernel_init=L.xavier_init(),
-                   bias_init=L.constant_init(0.2), dtype=self.dtype)(x)
-        return nn.relu(x)
+                   padding=self.padding, use_bias=False,
+                   kernel_init=L.xavier_init(), dtype=self.dtype)(x)
+        return L.BiasAct(self.features, bias_init=L.constant_init(0.2),
+                         act="relu", impl=self.act_impl)(x)
 
 
 class Inception(nn.Module):
@@ -51,16 +61,21 @@ class Inception(nn.Module):
     b5: int          # 5x5 branch width
     bp: int          # pool-projection width
     dtype: jnp.dtype = jnp.float32
+    act_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
-        p1 = ConvRelu(self.b1, (1, 1), dtype=self.dtype)(x)
-        p3 = ConvRelu(self.b3r, (1, 1), dtype=self.dtype)(x)
-        p3 = ConvRelu(self.b3, (3, 3), dtype=self.dtype)(p3)
-        p5 = ConvRelu(self.b5r, (1, 1), dtype=self.dtype)(x)
-        p5 = ConvRelu(self.b5, (5, 5), dtype=self.dtype)(p5)
+        def conv(features, kernel):
+            return ConvRelu(features, kernel, dtype=self.dtype,
+                            act_impl=self.act_impl)
+
+        p1 = conv(self.b1, (1, 1))(x)
+        p3 = conv(self.b3r, (1, 1))(x)
+        p3 = conv(self.b3, (3, 3))(p3)
+        p5 = conv(self.b5r, (1, 1))(x)
+        p5 = conv(self.b5, (5, 5))(p5)
         pp = nn.max_pool(x, (3, 3), (1, 1), padding="SAME")
-        pp = ConvRelu(self.bp, (1, 1), dtype=self.dtype)(pp)
+        pp = conv(self.bp, (1, 1))(pp)
         return jnp.concatenate([p1, p3, p5, pp], axis=-1)
 
 
@@ -70,11 +85,13 @@ class AuxHead(nn.Module):
 
     n_classes: int
     dtype: jnp.dtype = jnp.float32
+    act_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool):
         x = nn.avg_pool(x, (5, 5), (3, 3), padding="VALID")
-        x = ConvRelu(128, (1, 1), dtype=self.dtype)(x)
+        x = ConvRelu(128, (1, 1), dtype=self.dtype,
+                     act_impl=self.act_impl)(x)
         x = x.reshape((x.shape[0], -1))
         x = L.Dense(1024, kernel_init=L.gaussian_init(0.01),
                     bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
@@ -94,6 +111,8 @@ class GoogLeNetCNN(nn.Module):
     #: compiles — the aux-head/LRN/inception structure is what the
     #: contract tests care about, not the 1x widths.
     width_mult: float = 1.0
+    #: conv bias+relu epilogue (ModelConfig.bn_act_impl)
+    act_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -102,15 +121,19 @@ class GoogLeNetCNN(nn.Module):
 
         def inception(b1, b3r, b3, b5r, b5, bp):
             return Inception(w(b1), w(b3r), w(b3), w(b5r), w(b5), w(bp),
-                             self.dtype)
+                             self.dtype, self.act_impl)
+
+        def conv(features, kernel, **kw):
+            return ConvRelu(features, kernel, dtype=self.dtype,
+                            act_impl=self.act_impl, **kw)
 
         x = x.astype(self.dtype)
         # stem
-        x = ConvRelu(w(64), (7, 7), strides=(2, 2), dtype=self.dtype)(x)
+        x = conv(w(64), (7, 7), strides=(2, 2))(x)
         x = L.max_pool(x, 3, 2, padding="SAME")
         x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
-        x = ConvRelu(w(64), (1, 1), dtype=self.dtype)(x)
-        x = ConvRelu(w(192), (3, 3), dtype=self.dtype)(x)
+        x = conv(w(64), (1, 1))(x)
+        x = conv(w(192), (3, 3))(x)
         x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
         x = L.max_pool(x, 3, 2, padding="SAME")
         # inception 3a/3b
@@ -119,12 +142,14 @@ class GoogLeNetCNN(nn.Module):
         x = L.max_pool(x, 3, 2, padding="SAME")
         # inception 4a..4e with aux heads off 4a and 4d
         x = inception(192, 96, 208, 16, 48, 64)(x)
-        aux1 = (AuxHead(self.n_classes, self.dtype, name="aux1")(x, train)
+        aux1 = (AuxHead(self.n_classes, self.dtype, self.act_impl,
+                         name="aux1")(x, train)
                 if train else None)
         x = inception(160, 112, 224, 24, 64, 64)(x)
         x = inception(128, 128, 256, 24, 64, 64)(x)
         x = inception(112, 144, 288, 32, 64, 64)(x)
-        aux2 = (AuxHead(self.n_classes, self.dtype, name="aux2")(x, train)
+        aux2 = (AuxHead(self.n_classes, self.dtype, self.act_impl,
+                         name="aux2")(x, train)
                 if train else None)
         x = inception(256, 160, 320, 32, 128, 128)(x)
         x = L.max_pool(x, 3, 2, padding="SAME")
@@ -164,7 +189,8 @@ class GoogLeNet(TpuModel):
 
     def build_module(self) -> nn.Module:
         dtype = self._compute_dtype()
-        return GoogLeNetCNN(n_classes=self.data.n_classes, dtype=dtype)
+        return GoogLeNetCNN(n_classes=self.data.n_classes, dtype=dtype,
+                            act_impl=self.config.bn_act_impl)
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir, crop=224,

@@ -20,7 +20,10 @@ from typing import Any, Callable, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from flax.linen import dtypes as _flax_dtypes
+from jax import lax
 
+from theanompi_tpu.ops.fused_bn import scale_bias_act
 from theanompi_tpu.ops.lrn import lrn
 
 Dtype = Any
@@ -98,6 +101,123 @@ class LRN(nn.Module):
         return lrn(x, self.n, self.k, self.alpha, self.beta)
 
 
+class BatchNormAct(nn.Module):
+    """BatchNorm with a fusable activation/residual epilogue.
+
+    Drop-in for ``nn.BatchNorm`` (+ a following relu / residual add):
+    the variable layout is IDENTICAL to flax's — params ``scale``/
+    ``bias``, batch_stats ``mean``/``var`` — so a module that pins the
+    instance name (``name='BatchNorm_0'``) swaps implementations
+    without moving a single leaf of the param tree, and checkpoints
+    stay loadable across the ``impl`` knob.
+
+    ``impl='xla'`` (default) reproduces today's unfused composition
+    bit-for-bit: flax-style normalize (f32 stats, fast variance,
+    ``maximum(0, E[x^2]-E[x]^2)``), cast to the compute dtype, then
+    ``+ residual`` and relu as separate ops for XLA to fuse as it sees
+    fit.  ``impl='pallas'`` folds the affine
+    (``scale*rsqrt(var+eps)``, ``bias - mean*scale_eff``) and runs the
+    whole epilogue as ONE Pallas stream over the activation
+    (ops/fused_bn.py) — the batch-stat reductions stay XLA either way.
+    This is the seam the MFU account's 5.81 ms of loop-fusion HBM
+    traffic funnels through (artifacts/fusion_deepdive.json).
+
+    ``act`` is ``None`` or ``'relu'``; ``residual`` (same shape as x)
+    is added before the activation — the bottleneck-exit
+    ``relu(bn(y) + shortcut)`` pattern.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Dtype | None = None
+    param_dtype: Dtype = jnp.float32
+    axis_name: str | None = None
+    act: str | None = None
+    impl: str = "xla"            # 'xla' | 'pallas' (ModelConfig.bn_act_impl)
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x, residual=None):
+        features = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (features,),
+                           self.param_dtype)
+        bias = self.param("bias", self.bias_init, (features,),
+                          self.param_dtype)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32),
+                                (features,))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32),
+                               (features,))
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # flax _compute_stats semantics: f32 reductions, fast
+            # variance clipped at zero, mean+mean2 stacked into ONE
+            # pmean when cross-replica (sync_bn)
+            xf = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            mean = xf.mean(axes)
+            mean2 = (xf * xf).mean(axes)
+            if self.axis_name is not None and not self.is_initializing():
+                mean, mean2 = lax.pmean(jnp.stack([mean, mean2]),
+                                        self.axis_name)
+            var = jnp.maximum(0.0, mean2 - mean * mean)
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value
+                                 + (1 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value
+                                + (1 - self.momentum) * var)
+        out_dtype = _flax_dtypes.canonicalize_dtype(x, scale, bias,
+                                                    dtype=self.dtype)
+        if self.impl == "xla":
+            # exactly flax _normalize + the models' epilogue ops, so
+            # the default path is numerically unchanged
+            mul = lax.rsqrt(var + self.epsilon) * scale
+            y = (x - mean) * mul + bias
+            y = jnp.asarray(y, out_dtype)
+            if residual is not None:
+                y = y + residual
+            if self.act == "relu":
+                y = nn.relu(y)
+            return y
+        scale_eff = scale * lax.rsqrt(var + self.epsilon)
+        bias_eff = bias - mean * scale_eff
+        return scale_bias_act(x, scale_eff, bias_eff, residual=residual,
+                              act=self.act, impl=self.impl,
+                              out_dtype=out_dtype)
+
+
+class BiasAct(nn.Module):
+    """Per-channel bias + activation — the conv epilogue of the BN-free
+    zoo members (VGG, GoogLeNet).  With ``impl='pallas'`` the bias add
+    and relu run as one fused stream (``scale=1`` through
+    ops/fused_bn.py); ``impl='xla'`` matches ``nn.Conv``'s own bias-add
+    (compute-dtype add) followed by relu.  NOTE: fusing moves the bias
+    param from ``Conv_*/bias`` to this module's ``bias`` — the param
+    TREE differs between a model built with fusion on vs off (unlike
+    BatchNormAct, whose layout is pinned), so flip the knob at model
+    build, not mid-run.
+    """
+
+    features: int
+    bias_init: Callable = nn.initializers.zeros
+    act: str | None = "relu"
+    impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x):
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          jnp.float32)
+        if self.impl == "xla":
+            y = x + bias.astype(x.dtype)
+            return nn.relu(y) if self.act == "relu" else y
+        return scale_bias_act(x, jnp.ones_like(bias), bias, act=self.act,
+                              impl=self.impl, out_dtype=x.dtype)
+
+
 class BatchNorm(nn.Module):
     """BN with the running stats in the 'batch_stats' collection.
 
@@ -125,16 +245,25 @@ class BatchNorm(nn.Module):
     epsilon: float = 1e-5
     dtype: Dtype = jnp.float32
     axis_name: str | None = None
+    #: optional fused epilogue (BatchNormAct): act None|'relu', impl
+    #: 'xla'|'pallas'.  The inner module is pinned to the name flax
+    #: auto-assigned before this seam existed ('BatchNorm_0'), so the
+    #: param tree is byte-identical to the old nn.BatchNorm wrapper.
+    act: str | None = None
+    impl: str = "xla"
 
     @nn.compact
-    def __call__(self, x):
-        return nn.BatchNorm(
+    def __call__(self, x, residual=None):
+        return BatchNormAct(
             use_running_average=self.use_running_average,
             momentum=self.momentum,
             epsilon=self.epsilon,
             dtype=self.dtype,
             axis_name=self.axis_name,
-        )(x)
+            act=self.act,
+            impl=self.impl,
+            name="BatchNorm_0",
+        )(x, residual=residual)
 
 
 class Dense(nn.Module):

@@ -27,7 +27,15 @@ class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut on
     stride/width change.  The final BN's scale is init to zero
     (standard residual-friendly init; keeps early training stable at
-    large global batch)."""
+    large global batch).
+
+    Every BN carries its epilogue (relu; the exit BN also the shortcut
+    add) through ``layers.BatchNormAct`` so ``bn_act_impl='pallas'``
+    runs each one as a single fused HBM stream — the loop-fusion slice
+    of the MFU account.  Instance names pin flax's old auto-numbering
+    (``BatchNorm_{i}`` in creation order), so the param tree is
+    identical to the pre-seam module and independent of the impl knob.
+    """
 
     features: int            # bottleneck width; output is 4x this
     strides: tuple[int, int] = (1, 1)
@@ -35,13 +43,18 @@ class BottleneckBlock(nn.Module):
     #: named mesh axis to pmean BN stats over (cross-replica BN);
     #: None = per-shard stats (the reference's per-worker semantics)
     bn_axis: str | None = None
+    #: BN+act epilogue impl (ModelConfig.bn_act_impl): 'xla' | 'pallas'
+    bn_act_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool):
-        norm = lambda scale_init=nn.initializers.ones: nn.BatchNorm(  # noqa: E731
-            use_running_average=not train, momentum=0.9, epsilon=1e-5,
-            dtype=self.dtype, scale_init=scale_init,
-            axis_name=self.bn_axis)
+        bn_i = iter(range(4))
+        norm = lambda act=None, scale_init=nn.initializers.ones: (  # noqa: E731
+            L.BatchNormAct(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=self.dtype, scale_init=scale_init,
+                axis_name=self.bn_axis, act=act, impl=self.bn_act_impl,
+                name=f"BatchNorm_{next(bn_i)}"))
         out_features = self.features * 4
 
         residual = x
@@ -52,15 +65,14 @@ class BottleneckBlock(nn.Module):
             residual = norm()(residual)
 
         y = L.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        y = norm()(y)
-        y = nn.relu(y)
+        y = norm(act="relu")(y)
         y = L.Conv(self.features, (3, 3), strides=self.strides,
                    use_bias=False, dtype=self.dtype)(y)
-        y = norm()(y)
-        y = nn.relu(y)
+        y = norm(act="relu")(y)
         y = L.Conv(out_features, (1, 1), use_bias=False, dtype=self.dtype)(y)
-        y = norm(scale_init=nn.initializers.zeros)(y)
-        return nn.relu(y + residual)
+        # exit epilogue: relu(bn(y) + shortcut) in one fused stream
+        return norm(act="relu", scale_init=nn.initializers.zeros)(
+            y, residual=residual)
 
 
 def space_to_depth(x, block: int = 2):
@@ -108,6 +120,9 @@ class ResNet(nn.Module):
     #: stem max-pool impl (ModelConfig.pool_impl): 'xla' or 'pallas'
     #: (argmax-saving kernel, ops/maxpool_pallas.py)
     pool_impl: str = "xla"
+    #: BN+activation epilogue impl (ModelConfig.bn_act_impl): 'xla'
+    #: (unfused reference path) or 'pallas' (ops/fused_bn.py)
+    bn_act_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -127,9 +142,10 @@ class ResNet(nn.Module):
                        dtype=self.dtype, name="stem_conv")(x)
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype, name="stem_bn",
-                         axis_name=self.bn_axis)(x)
+        x = L.BatchNormAct(use_running_average=not train, momentum=0.9,
+                           epsilon=1e-5, dtype=self.dtype, name="stem_bn",
+                           axis_name=self.bn_axis,
+                           impl=self.bn_act_impl)(x)
         # relu AFTER the pool: max-pooling commutes with relu (max of
         # relu == relu of max, -inf pool padding never wins, and the
         # backward argmax selection is identical), so this is
@@ -146,7 +162,8 @@ class ResNet(nn.Module):
             for block in range(n_blocks):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
                 x = BottleneckBlock(self.width * (2 ** stage), strides,
-                                    self.dtype, self.bn_axis)(x, train)
+                                    self.dtype, self.bn_axis,
+                                    self.bn_act_impl)(x, train)
         x = L.global_avg_pool(x)
         x = L.Dense(self.n_classes, kernel_init=L.xavier_init())(x)
         return x.astype(jnp.float32)
@@ -187,7 +204,8 @@ class ResNet50(TpuModel):
                       dtype=self._compute_dtype(),
                       stem=self.config.resnet_stem,
                       bn_axis=self._bn_axis(),
-                      pool_impl=self.config.pool_impl)
+                      pool_impl=self.config.pool_impl,
+                      bn_act_impl=self.config.bn_act_impl)
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir,

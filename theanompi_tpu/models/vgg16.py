@@ -30,17 +30,30 @@ class VGGCNN(nn.Module):
     blocks: tuple = VGG16_BLOCKS
     n_classes: int = 1000
     dtype: jnp.dtype = jnp.float32
+    #: conv bias+relu epilogue (ModelConfig.bn_act_impl): 'pallas'
+    #: fuses them into one stream via layers.BiasAct — NOTE the bias
+    #: param moves from Conv_*/bias to BiasAct_*/bias, so the param
+    #: tree depends on this knob (see layers.BiasAct)
+    act_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         for n_convs, features in self.blocks:
             for _ in range(n_convs):
-                x = L.Conv(features, (3, 3),
-                           kernel_init=L.he_init(),
-                           bias_init=L.constant_init(0.0),
-                           dtype=self.dtype)(x)
-                x = nn.relu(x)
+                if self.act_impl == "xla":
+                    x = L.Conv(features, (3, 3),
+                               kernel_init=L.he_init(),
+                               bias_init=L.constant_init(0.0),
+                               dtype=self.dtype)(x)
+                    x = nn.relu(x)
+                else:
+                    x = L.Conv(features, (3, 3), use_bias=False,
+                               kernel_init=L.he_init(),
+                               dtype=self.dtype)(x)
+                    x = L.BiasAct(features,
+                                  bias_init=L.constant_init(0.0),
+                                  act="relu", impl=self.act_impl)(x)
             x = L.max_pool(x, 2, 2)
         x = x.reshape((x.shape[0], -1))
         x = L.Dense(4096, kernel_init=L.gaussian_init(0.005),
@@ -80,7 +93,8 @@ class VGG16(TpuModel):
 
     def build_module(self) -> nn.Module:
         return VGGCNN(blocks=self.blocks, n_classes=self.data.n_classes,
-                      dtype=self._compute_dtype())
+                      dtype=self._compute_dtype(),
+                      act_impl=self.config.bn_act_impl)
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir, crop=224,

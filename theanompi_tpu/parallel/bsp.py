@@ -91,6 +91,21 @@ def _fold_axis_rng(rng, reduce_axes: tuple[str, ...]):
     return rng
 
 
+def _donate_argnums(donate: bool, donate_batch: bool) -> tuple[int, ...]:
+    """argnums for the stacked-cadence steps: state (0) and optionally
+    the staged batch (1).  The r3/r4 copy account charges 2.37 ms/step
+    to 1 334 copy-done events; keeping a multi-megabyte staged batch
+    alive across the whole scanned program forces XLA to copy around
+    it, so the cadences donate it by default — the prefetcher stages a
+    fresh batch per dispatch and never touches one after yielding it.
+    ``donate_batch`` exists for callers that deliberately replay one
+    staged batch (bench.py's device-step leg; equivalence tests that
+    re-feed a stacked batch to a second step builder)."""
+    if not donate:
+        return ()
+    return (0, 1) if donate_batch else (0,)
+
+
 def _exchange_grads_and_update(exchanger: BSP_Exchanger,
                                tx: optax.GradientTransformation,
                                state: "TrainState", grads, new_ms,
@@ -182,6 +197,7 @@ def make_bsp_multi_step(
     mesh: jax.sharding.Mesh,
     exchanger: BSP_Exchanger | None = None,
     donate: bool = True,
+    donate_batch: bool = True,
     batch_partition: P = P(AXIS_DATA),
     reduce_axes: tuple[str, ...] = (AXIS_DATA,),
 ):
@@ -222,7 +238,8 @@ def make_bsp_multi_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return jax.jit(sharded,
+                   donate_argnums=_donate_argnums(donate, donate_batch))
 
 
 def accumulate_microbatch_grads(loss_fn: LossFn, params, model_state,
@@ -254,6 +271,7 @@ def make_bsp_accum_step(
     mesh: jax.sharding.Mesh,
     exchanger: BSP_Exchanger | None = None,
     donate: bool = True,
+    donate_batch: bool = True,
     batch_partition: P = P(AXIS_DATA),
     reduce_axes: tuple[str, ...] = (AXIS_DATA,),
 ):
@@ -300,7 +318,8 @@ def make_bsp_accum_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return jax.jit(sharded,
+                   donate_argnums=_donate_argnums(donate, donate_batch))
 
 
 def make_bsp_eval_step(

@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 
 from theanompi_tpu.parallel.bsp import (
     TrainState,
+    _donate_argnums,
     accumulate_microbatch_grads,
     apply_update,
     grad_and_metrics,
@@ -118,6 +119,7 @@ def make_bsp_fsdp_step(
     params_template: PyTree,
     avg: bool = True,
     donate: bool = True,
+    donate_batch: bool = True,
     batch_partition: P = P(AXIS_DATA),
     multi: bool = False,
     accum: bool = False,
@@ -197,9 +199,12 @@ def make_bsp_fsdp_step(
     else:
         fn = one_step
 
+    # the stacked cadences donate the staged batch like parallel/bsp.py
+    # (same copy-done rationale + the same opt-out for batch replayers)
+    dn = _donate_argnums(donate, donate_batch and (accum or multi))
     return jax.jit(fn,
                    in_shardings=(state_sharding, batch_sharding, rep),
                    out_shardings=(state_sharding, None),
-                   donate_argnums=(0,) if donate else ())
+                   donate_argnums=dn)
 
 

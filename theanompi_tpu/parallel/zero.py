@@ -41,6 +41,7 @@ from jax.sharding import PartitionSpec as P
 
 from theanompi_tpu.parallel.bsp import (
     TrainState,
+    _donate_argnums,
     _fold_axis_rng,
     _pmean,
     accumulate_microbatch_grads,
@@ -117,6 +118,7 @@ def make_bsp_zero_step(
     params_template: PyTree,
     avg: bool = True,
     donate: bool = True,
+    donate_batch: bool = True,
     batch_partition: P = P(AXIS_DATA),
     reduce_axes: tuple[str, ...] = (AXIS_DATA,),
     accum: bool = False,
@@ -230,4 +232,7 @@ def make_bsp_zero_step(
         out_specs=(state_in_specs, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    # the stacked cadences donate the staged batch like parallel/bsp.py
+    # (same copy-done rationale + the same opt-out for batch replayers)
+    dn = _donate_argnums(donate, donate_batch and (accum or multi))
+    return jax.jit(sharded, donate_argnums=dn)

@@ -51,6 +51,144 @@ def hlo_output_part(hlo_text: str) -> str:
         else hlo_text.split("(")[0]
 
 
+_COPY_SHAPE = re.compile(r"copy-done\(\((\w+)\[([\d,]*)\]")
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "f16": 2,
+                "s8": 1, "u8": 1, "pred": 1}
+#: one full shape token inside an HLO tuple: dtype[dims]{layout}
+_SHAPE_TOK = re.compile(r"(\w+)\[([\d,]*)\]\{([^}]*)\}")
+
+
+def _size_class(nbytes: int) -> str:
+    """'param_vec' (<=64 KiB — BN scales, biases, optimizer scalars),
+    'kernel' (<=4 MiB), 'activation' (larger) — THE size thresholds,
+    shared by copy_size_class and attribute_copies so the two views
+    cannot classify one event differently."""
+    if nbytes <= 64 * 1024:
+        return "param_vec"
+    if nbytes <= 4 * 1024 * 1024:
+        return "kernel"
+    return "activation"
+
+
+def _shape_nbytes(dtype: str, dims: str) -> int:
+    """Bytes of one ``dtype[dims]`` shape token — THE byte math for
+    every copy view in this file."""
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def copy_size_class(name: str) -> str:
+    """Size class of the tensor a copy-done materialises, parsed from
+    the copy's tuple-shape text; 'unknown' when no copy tuple is
+    present.  (Shared with tools/fusion_deepdive.py.)"""
+    m = _COPY_SHAPE.search(name)
+    if not m:
+        return "unknown"
+    return _size_class(_shape_nbytes(m.group(1), m.group(2)))
+
+
+def shrink_tf_op(tf_op: str) -> str:
+    """'jit(shard_step)/jvp(ResNet)/BottleneckBlock_1/add:' ->
+    'fwd/BottleneckBlock_1/add' (strip jit wrapper, fold jvp/transpose
+    into fwd/bwd, drop trailing colon).  Empty in -> empty out, so
+    callers' ``or``-fallbacks to the display name still fire.
+    (Shared with tools/fusion_deepdive.py.)"""
+    if not tf_op:
+        return ""
+    s = tf_op.rstrip(":")
+    direction = "bwd" if "transpose(" in s else "fwd"
+    s = re.sub(r"jit\([^)]*\)/", "", s)
+    s = re.sub(r"(transpose\(|jvp\(|\))", "", s)
+    return f"{direction}/{s}"
+
+
+def copy_endpoints(name: str) -> tuple[str, str, str, int]:
+    """(direction, shape, dest_layout, nbytes) of one copy-done event.
+
+    The r3 capture's copy events carry NO tf_op (the source-op stat is
+    empty on every one of the 6 670), so attribution has to come from
+    the HLO text itself: a copy-start's operand tuple is ``(dest, src,
+    context)`` and the memory-space suffix on the layouts says which
+    way the bytes flow — ``S(1)`` is the compiler-managed alternate
+    memory (MSA/VMEM prefetch space):
+
+    - dest in S(1): ``prefetch`` — HBM -> on-chip staging of a buffer
+      the scheduler wants resident before use (the 1 146 tiny
+      param-vector copies of the account);
+    - src in S(1): ``writeback`` — staged/produced on-chip, copied out
+      to a fresh HBM buffer.  A big batch-led shape here is the smoking
+      gun for a live input buffer XLA could not alias (donation gap);
+    - neither: ``move`` — an HBM->HBM copy (layout change or alias
+      materialization).
+    """
+    m = re.search(r"copy-done\(\((.*)", name)
+    toks = _SHAPE_TOK.findall(m.group(1)) if m else []
+    if len(toks) < 2:
+        return "unknown", "?", "", 0
+    (d_dt, d_dims, d_lay), (_s_dt, _s_dims, s_lay) = toks[0], toks[1]
+    nbytes = _shape_nbytes(d_dt, d_dims)
+    if "S(1)" in d_lay:
+        direction = "prefetch"
+    elif "S(1)" in s_lay:
+        direction = "writeback"
+    else:
+        direction = "move"
+    return direction, f"{d_dt}[{d_dims}]", d_lay, nbytes
+
+
+def attribute_copies(events: list[dict], n_steps: int) -> dict:
+    """The copy-done account: every copy event attributed to what it
+    copies (direction x size-class x shape), sorted by time.
+
+    The r4 account flags 2.37 ms/step across 1 334 copy-done events as
+    near-zero-FLOP residue; this names each slice so the fix (buffer
+    donation, layout pinning) can be targeted and the after-capture
+    diffed per row (tools/xla_sweep.py consumes two of these).
+    """
+    rows = defaultdict(lambda: [0, 0, 0])      # dur_ps, bytes, n
+    done_dur = done_n = start_dur = start_n = 0
+    for e in events:
+        if e["category"] == "copy-start":
+            start_dur += e["dur_ps"]
+            start_n += 1
+            continue
+        if e["category"] != "copy-done":
+            continue
+        done_dur += e["dur_ps"]
+        done_n += 1
+        direction, shape, _lay, nbytes = copy_endpoints(e["name"])
+        cls = _size_class(nbytes) if direction != "unknown" \
+            else "unknown"
+        a = rows[(direction, cls, shape)]
+        a[0] += e["dur_ps"]
+        a[1] += nbytes
+        a[2] += 1
+
+    out_rows = []
+    for (direction, cls, shape), (dur, nbytes, n) in sorted(
+            rows.items(), key=lambda kv: -kv[1][0]):
+        ms = dur / 1e9 / n_steps
+        out_rows.append({
+            "producer": f"{direction}:{cls}:{shape}",
+            "ms_per_step": round(ms, 3),
+            "events_per_step": n // n_steps,
+            "us_per_event": round(dur / 1e6 / n, 2) if n else 0.0,
+            "mbytes_per_step": round(nbytes / 1e6 / n_steps, 2),
+            "pct_of_copy_done": round(100 * dur / done_dur, 1)
+            if done_dur else 0.0,
+        })
+    return {
+        "copy_done_ms_per_step": round(done_dur / 1e9 / n_steps, 3),
+        "copy_done_events_per_step": done_n // n_steps,
+        "copy_start_ms_per_step": round(start_dur / 1e9 / n_steps, 3),
+        "copy_start_events_per_step": start_n // n_steps,
+        "rows": out_rows,
+    }
+
+
 def conv_spatial_bucket(hlo_text: str, tf_op: str = "") -> str:
     """Bucket a conv fusion by its ACTIVATION shape + pass kind.
 
@@ -294,6 +432,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("path", help="profile dir or .xplane.pb file")
     ap.add_argument("--out", default=None, help="write full JSON here")
+    ap.add_argument("--copies", action="store_true",
+                    help="attribute every copy-start/done event to its "
+                         "producer (direction x size-class x shape)")
     args = ap.parse_args()
 
     pb = find_xplane(args.path)
@@ -329,10 +470,25 @@ def main() -> int:
     for k, c in report["conv_buckets"].items():
         print(f"{k:<26}{c['ms_per_step']:9.3f}{c['pct']:7.1f}"
               f"{c['tflops_per_s']:8.1f}{c['gbytes_per_s']:8.0f}")
+    copies = None
+    if args.copies:
+        copies = attribute_copies(events, n_steps)
+        print(f"\n== copy attribution: copy-done "
+              f"{copies['copy_done_ms_per_step']} ms/step over "
+              f"{copies['copy_done_events_per_step']} events (+ "
+              f"copy-start {copies['copy_start_ms_per_step']} ms) ==")
+        print(f"{'ms/step':>8}{'n':>6}{'us/ea':>7}{'MB/step':>9}"
+              f"{'%copy':>7}  producer")
+        for r in copies["rows"][:20]:
+            print(f"{r['ms_per_step']:8.3f}{r['events_per_step']:6d}"
+                  f"{r['us_per_event']:7.2f}{r['mbytes_per_step']:9.1f}"
+                  f"{r['pct_of_copy_done']:7.1f}  {r['producer']}")
     if args.out:
         with open(args.out, "w") as f:
-            json.dump({"device": info, "report": report,
-                       "roofline": rl, "source": pb}, f, indent=1)
+            json.dump({"device": info, "report": report, "roofline": rl,
+                       **({"copy_attribution": copies} if copies
+                          else {}),
+                       "source": pb}, f, indent=1)
         print(f"\nwrote {args.out}")
     return 0
 

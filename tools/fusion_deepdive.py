@@ -36,48 +36,12 @@ from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from analyze_xplane import (SUB_RESOLUTION_MS, _load_xspace,  # noqa: E402
-                            extract_device_events, find_xplane,
-                            hlo_output_part)
-
-_COPY_SHAPE = re.compile(r"copy-done\(\((\w+)\[([\d,]*)\]")
-_DTYPE_BYTES = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "f16": 2,
-                "s8": 1, "u8": 1, "pred": 1}
-
-
-def copy_size_class(name: str) -> str:
-    """Size class of the tensor a copy-done materialises, parsed from
-    the copy's tuple-shape text: 'param_vec' (<=64 KiB — BN scales,
-    biases, optimizer scalars), 'kernel' (<=4 MiB), 'activation'
-    (larger), or 'unknown'."""
-    m = _COPY_SHAPE.search(name)
-    if not m:
-        return "unknown"
-    dtype, dims = m.group(1), m.group(2)
-    n = 1
-    for d in dims.split(","):
-        if d:
-            n *= int(d)
-    nbytes = n * _DTYPE_BYTES.get(dtype, 4)
-    if nbytes <= 64 * 1024:
-        return "param_vec"
-    if nbytes <= 4 * 1024 * 1024:
-        return "kernel"
-    return "activation"
-
-
-def shrink_tf_op(tf_op: str) -> str:
-    """'jit(shard_step)/jvp(ResNet)/BottleneckBlock_1/add:' ->
-    'fwd/BottleneckBlock_1/add' (strip jit wrapper, fold jvp/transpose
-    into fwd/bwd, drop trailing colon).  Empty in -> empty out, so
-    callers' ``or``-fallbacks to the display name still fire."""
-    if not tf_op:
-        return ""
-    s = tf_op.rstrip(":")
-    direction = "bwd" if "transpose(" in s else "fwd"
-    s = re.sub(r"jit\([^)]*\)/", "", s)
-    s = re.sub(r"(transpose\(|jvp\(|\))", "", s)
-    return f"{direction}/{s}"
+# the shape/size/source-op helpers moved to analyze_xplane (its
+# --copies attribution needs them too); re-exported here so existing
+# imports keep working
+from analyze_xplane import (SUB_RESOLUTION_MS, _load_xspace,  # noqa: E402,F401
+                            copy_size_class, extract_device_events,
+                            find_xplane, hlo_output_part, shrink_tf_op)
 
 
 def out_shape(name: str) -> str:

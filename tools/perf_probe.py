@@ -42,7 +42,8 @@ def time_step(step, state, batch, rng, n_steps: int, warmup: int = 3):
     return dt / n_steps, state
 
 
-def build(batch: int, dtype: str, variant: str):
+def build(batch: int, dtype: str, variant: str,
+          bn_act_impl: str = "xla", pool_impl: str = "xla"):
     from theanompi_tpu.models.base import ModelConfig
     from theanompi_tpu.models.resnet50 import ResNet50
     from theanompi_tpu.data.imagenet import ImageNet_data
@@ -72,7 +73,8 @@ def build(batch: int, dtype: str, variant: str):
                                  synthetic_pool=1, synthetic_store=32)
 
     cfg = ModelConfig(batch_size=batch, compute_dtype=dtype,
-                      track_top5=False, print_freq=10**9)
+                      track_top5=False, print_freq=10**9,
+                      bn_act_impl=bn_act_impl, pool_impl=pool_impl)
     model = ProbeResNet50(config=cfg, mesh=mesh, verbose=False)
     if variant not in ("base", "uint8"):
         raise ValueError(variant)
@@ -101,13 +103,22 @@ def main():
                     help="appended to XLA_FLAGS before first backend use "
                     "(round-5 queue: capture the profile under the "
                     "scoped-VMEM flag that wins the sweep)")
+    ap.add_argument("--bn-act-impl", default="xla",
+                    choices=("xla", "pallas"),
+                    help="BN/activation epilogue kernel "
+                    "(ops/fused_bn.py) — the A/B lever of the "
+                    "xla_sweep fused-epilogue entries")
+    ap.add_argument("--pool-impl", default="xla",
+                    choices=("xla", "pallas"),
+                    help="stem maxpool kernel (ops/maxpool_pallas.py)")
     args = ap.parse_args()
     if args.xla_flags:
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                    + " " + args.xla_flags)
 
     for b in args.batch:
-        model, staged, mesh, global_batch = build(b, args.dtype, args.variant)
+        model, staged, mesh, global_batch = build(
+            b, args.dtype, args.variant, args.bn_act_impl, args.pool_impl)
         rng = jax.random.key(0)
         step_s, state = time_step(model.train_step, model.state, staged, rng,
                                   args.steps)
@@ -116,6 +127,7 @@ def main():
         tflops = per_chip * TRAIN_GFLOP_PER_IMAGE / 1000.0
         print(json.dumps({
             "batch_per_chip": b, "dtype": args.dtype, "variant": args.variant,
+            "bn_act_impl": args.bn_act_impl, "pool_impl": args.pool_impl,
             "step_ms": round(step_s * 1e3, 2),
             "images_per_sec_per_chip": round(per_chip, 1),
             "tflops_per_chip": round(tflops, 1),

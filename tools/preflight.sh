@@ -13,8 +13,19 @@ LOG="${1:-artifacts/preflight.log}"
 cd "$(dirname "$0")/.."
 {
   echo "# preflight $(date -u +%Y-%m-%dT%H:%M:%SZ) HEAD=$(git rev-parse --short HEAD)"
-  echo "## pytest --runslow"
-  python -m pytest tests/ --runslow -q
+  echo "## pytest slow-subset gate (-m gate)"
+  # The tagged MUST-PASS slow subset (pyproject markers: 'gate') runs
+  # as its OWN step so an environmental failure elsewhere in the full
+  # --runslow set (e.g. this jax's multihost-on-CPU limitation) can
+  # never mask a broken gate test — the round-3 failure mode was a
+  # committed-but-never-run slow e2e, and a habitually-red full suite
+  # recreates exactly that blind spot.  Currently gated: the jpeg-tree
+  # end-to-end training oracle (tests/test_oracle.py).
+  python -m pytest tests/ --runslow -q -m gate
+  GATE_RC=$?
+  echo "gate subset rc=$GATE_RC"
+  echo "## pytest --runslow (-m 'not gate' — the gate subset just ran)"
+  python -m pytest tests/ --runslow -q -m 'not gate'
   PYTEST_RC=$?
   echo "pytest rc=$PYTEST_RC"
   echo "## __graft_entry__ (entry + dryrun_multichip on the virtual mesh)"
@@ -105,8 +116,9 @@ PYEOF
   RESILIENCE_RC=$?
   rm -rf "$FAULTDIR"
   echo "resilience smoke rc=$RESILIENCE_RC"
-  if [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ]; then
+  if [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
+    [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
     exit 1
   fi
   echo "PREFLIGHT: GREEN"

@@ -77,7 +77,11 @@ def main() -> int:
 
     cfg = ModelConfig(batch_size=args.batch, compute_dtype="bfloat16",
                       steps_per_call=args.k, resnet_stem=args.stem,
-                      track_top5=False, print_freq=10**9)
+                      track_top5=False, print_freq=10**9,
+                      # this harness replays ONE staged batch through
+                      # every dispatch; donation would delete it after
+                      # the first (bench.py has the same opt-out)
+                      donate_batch=False)
     model = PointResNet50(config=cfg, mesh=mesh, verbose=False)
     model.compile_iter_fns("avg")
 

@@ -1,0 +1,283 @@
+"""Config-driven XLA flag sweep + A/B attribution reports (ISSUE 3).
+
+Round 4's verdict: the MFU account landed and then "no optimization,
+no XLA-flag sweep, no fusion experiment was attempted".  This harness
+closes the loop, in three subcommands:
+
+``emit``
+    Write queue-ready experiments (``[[name, argv, timeout_s], ...]``,
+    the ``run_tpu_queue.py --exps-json`` format) for FLAGS x MODEL
+    throughput points plus the ResNet-50 before/after *profile* pair —
+    every lever lands with an xplane capture so the win/loss is
+    attributed per category, not just a single img/s number.  The flag
+    sets come from ``SWEEPS`` (or ``--config`` JSON: {name: flags}).
+
+``report BEFORE.json AFTER.json``
+    Diff two ``analyze_xplane.py --out`` accounts: per-category
+    ms/step deltas, totals, and (when both captured with ``--copies``)
+    per-producer copy-done deltas.  This is the before/after evidence
+    format every optimization in this repo must ship with.
+
+``expected``
+    Write the committed expected-delta table for the queued ResNet-50
+    pair (artifacts/xla_sweep_expected.md) — the prediction is on
+    record BEFORE the tunnel window, so the after-capture grades the
+    model of the step, not just the step.
+
+Pure helpers (``ab_report``, ``build_entries``) are unit-tested in
+tests/test_xplane_tool.py without tensorflow or a chip.
+
+Usage:
+    python tools/xla_sweep.py emit --out artifacts/queue_xla_sweep_exps.json
+    python tools/xla_sweep.py report before.json after.json [--out ab.json]
+    python tools/xla_sweep.py expected --out artifacts/xla_sweep_expected.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+
+#: flag sets to sweep — each lever targets a named residual of the
+#: account (artifacts/mfu_account.json).  Keep this list short: every
+#: entry costs a compile (~40 s) + timed run in a scarce tunnel window.
+SWEEPS = {
+    # the r3/r5 baseline — revalidates the 2 622 img/s point in the
+    # same window so deltas aren't window-to-window noise
+    "base": "",
+    # latency-hiding scheduler: targets the 1 146 tiny MSA param
+    # prefetches (1.42 ms of latency, not bandwidth) + 0.62 ms
+    # async-done by overlapping them under the conv stream
+    "lhs": "--xla_tpu_enable_latency_hiding_scheduler=true",
+    # bigger scoped VMEM: fewer activation spills (0.93 ms) and fewer
+    # prefetch/writeback bounces for the wide stage-exit shapes
+    "vmem64m": "--xla_tpu_scoped_vmem_limit_kib=65536",
+    # both levers together — the expected winner
+    "lhs_vmem64m": ("--xla_tpu_enable_latency_hiding_scheduler=true "
+                    "--xla_tpu_scoped_vmem_limit_kib=65536"),
+}
+
+#: (model-point name, extra queue_resnet_point args) — the MODEL axis
+MODELS = {
+    "resnet_k4_b128": ["--k", "4", "--batch", "128"],
+    "resnet_k4_b128_s2d": ["--k", "4", "--batch", "128",
+                           "--stem", "s2d"],
+}
+
+
+def build_entries(sweeps: dict[str, str] | None = None,
+                  models: dict[str, list[str]] | None = None,
+                  trace_root: str = "artifacts/tpu_trace_sweep") -> list:
+    """[[name, argv, timeout_s], ...] — run_tpu_queue --exps-json rows.
+
+    Throughput: flags x models via queue_resnet_point.  Profiles: the
+    ResNet-50 A/B pair — 'before' re-captures the current default step
+    (same code as the committed r3 account, donation fix included) and
+    'after' flips the fused Pallas epilogues + maxpool, both through
+    perf_probe's uint8 flagship staging with an xplane trace, so
+    ``analyze_xplane --copies`` accounts can be diffed row-by-row with
+    the ``report`` subcommand.
+    """
+    sweeps = SWEEPS if sweeps is None else sweeps
+    models = MODELS if models is None else models
+    py = sys.executable or "python"
+    qp = os.path.join("tools", "queue_resnet_point.py")
+    pp = os.path.join("tools", "perf_probe.py")
+    entries = []
+    for mname, margs in models.items():
+        for sname, flags in sweeps.items():
+            argv = [py, qp, *margs]
+            if flags:
+                argv += ["--xla-flags", flags]
+            entries.append([f"sweep_{mname}_{sname}", argv, 900])
+    # the before/after PROFILE pair (ResNet-50 b=128, flagship uint8
+    # staging, 20 timed steps + 5 traced): before = default impls,
+    # after = fused scale-bias-relu + argmax maxpool backward
+    for tag, impl_args in (
+            ("before", []),
+            ("after_fused", ["--bn-act-impl", "pallas",
+                             "--pool-impl", "pallas"])):
+        entries.append([
+            f"resnet_ab_{tag}_profile",
+            [py, pp, "--batch", "128", "--steps", "20",
+             "--variant", "uint8",
+             "--trace", f"{trace_root}/{tag}", *impl_args],
+            1800])
+    return entries
+
+
+def _get_report(account: dict) -> dict:
+    """Accept a full ``analyze_xplane --out`` dict or a bare report."""
+    return account.get("report", account)
+
+
+def ab_report(before: dict, after: dict) -> dict:
+    """Per-category (and per-copy-producer) delta of two accounts."""
+    rb, ra = _get_report(before), _get_report(after)
+    cats = {}
+    for k in {**rb["categories"], **ra["categories"]}:
+        b = rb["categories"].get(k, {})
+        a = ra["categories"].get(k, {})
+        bm = b.get("ms_per_step", 0.0)
+        am = a.get("ms_per_step", 0.0)
+        cats[k] = {
+            "before_ms": bm, "after_ms": am,
+            "delta_ms": round(am - bm, 3),
+            "before_events": b.get("events_per_step", 0),
+            "after_events": a.get("events_per_step", 0),
+        }
+    tb = rb["totals"]["device_busy_ms_per_step"]
+    ta = ra["totals"]["device_busy_ms_per_step"]
+    out = {
+        "totals": {
+            "before_ms": tb, "after_ms": ta,
+            "delta_ms": round(ta - tb, 3),
+            "delta_pct": round(100 * (ta - tb) / tb, 1) if tb else 0.0,
+        },
+        "categories": dict(sorted(cats.items(),
+                                  key=lambda kv: kv[1]["delta_ms"])),
+    }
+    cb = before.get("copy_attribution")
+    ca = after.get("copy_attribution")
+    if cb and ca:
+        rows_b = {r["producer"]: r for r in cb["rows"]}
+        rows_a = {r["producer"]: r for r in ca["rows"]}
+        copies = {}
+        for k in {**rows_b, **rows_a}:
+            bm = rows_b.get(k, {}).get("ms_per_step", 0.0)
+            am = rows_a.get(k, {}).get("ms_per_step", 0.0)
+            copies[k] = {"before_ms": bm, "after_ms": am,
+                         "delta_ms": round(am - bm, 3)}
+        out["copy_producers"] = dict(
+            sorted(copies.items(), key=lambda kv: kv[1]["delta_ms"]))
+        out["copy_totals"] = {
+            "before_ms": cb["copy_done_ms_per_step"],
+            "after_ms": ca["copy_done_ms_per_step"],
+            "delta_ms": round(ca["copy_done_ms_per_step"]
+                              - cb["copy_done_ms_per_step"], 3),
+        }
+    return out
+
+
+def print_report(rep: dict) -> None:
+    t = rep["totals"]
+    print(f"# device-busy {t['before_ms']} -> {t['after_ms']} ms/step "
+          f"({t['delta_pct']:+.1f}%)")
+    print(f"{'category':<26}{'before':>9}{'after':>9}{'delta':>9}"
+          f"{'ev b/a':>12}")
+    for k, c in rep["categories"].items():
+        print(f"{k[:25]:<26}{c['before_ms']:9.3f}{c['after_ms']:9.3f}"
+              f"{c['delta_ms']:+9.3f}"
+              f"{c['before_events']:>6}/{c['after_events']:<5}")
+    if "copy_producers" in rep:
+        ct = rep["copy_totals"]
+        print(f"\n# copy-done {ct['before_ms']} -> {ct['after_ms']} "
+              f"ms/step ({ct['delta_ms']:+.3f})")
+        for k, c in list(rep["copy_producers"].items())[:15]:
+            print(f"{c['before_ms']:9.3f}{c['after_ms']:9.3f}"
+                  f"{c['delta_ms']:+9.3f}  {k}")
+
+
+EXPECTED_MD = """\
+# Expected deltas for the queued ResNet-50 A/B pair
+
+Committed BEFORE the tunnel window (ISSUE 3 acceptance): the
+`resnet_ab_before_profile` / `resnet_ab_after_fused_profile` entries
+in `artifacts/queue_xla_sweep_exps.json` capture both accounts; grade
+this table with
+
+    python tools/analyze_xplane.py artifacts/tpu_trace_sweep/before  --copies --out /tmp/b.json
+    python tools/analyze_xplane.py artifacts/tpu_trace_sweep/after_fused --copies --out /tmp/a.json
+    python tools/xla_sweep.py report /tmp/b.json /tmp/a.json
+
+Baseline: the r3 capture's 46.90 ms device-busy step
+(`artifacts/mfu_account.json`, `artifacts/copy_attribution_r03.json`).
+
+| lever | slice attacked (r3 measured) | expected after | basis |
+|---|---|---|---|
+| fused scale-bias-relu epilogue (`bn_act_impl='pallas'`, ops/fused_bn.py) | loop fusion 5.81 ms / 269 ev (adds+relu 678-992 GB/s) | 4.3-5.0 ms | the 3 stage-1 `BottleneckBlock_*/add` exit epilogues alone are 2.7 ms at 83% HBM; fusing BN-apply+add+relu into one stream removes one full read+write of each exit activation (~1/3 of those bytes). Fwd-only win — bwd mask recompute streams the same bytes XLA's does |
+| maxpool argmax backward (`pool_impl='pallas'`, ops/maxpool_pallas.py) | select-and-scatter 0.761 ms at 74% HBM peak | 0.35-0.45 ms | backward streams g+idx+dx ~282 MB instead of ~460 MB (kernel docstring); bound 0.34 ms at the slice's own 608 GB/s |
+| `--xla_tpu_enable_latency_hiding_scheduler=true` | 1 146 param-vec MSA copies 1.42 ms (latency-bound, ~1-7 us each) + async-done 0.62 ms | 0.7-1.2 ms combined | scheduler overlaps the tiny prefetches under the conv stream; per-copy latency doesn't shrink, exposure does |
+| `--xla_tpu_scoped_vmem_limit_kib=65536` | activation spill prefetch/writeback ~0.9 ms | 0.5-0.8 ms | r5 sweep precedent; bigger scoped VMEM keeps stage-exit activations resident. May TRADE against conv rate (less pipelining headroom) — that is why every flag point re-measures throughput, not just the account |
+
+**Not graded by this pair — staged-batch donation
+(`donate_batch`, parallel/bsp.py).** It only changes the stacked
+(k>1 / grad-accum) programs, and every batch-replaying queue harness
+(perf_probe, queue_resnet_point, bench.py's device leg) necessarily
+opts out with `donate_batch=False` — a replayed batch cannot be
+donated.  The profile pair above is a single-step program, so its
+copy-done delta excludes donation entirely; grade that lever from a
+prefetcher-fed k>1 `run_bsp_session` run — e.g.
+`THEANOMPI_TPU_PROFILE=dir python -m theanompi_tpu.launcher BSP -m
+cifar10 --epochs 1 --set steps_per_call=4` — in a later window.
+(NOT bench.py: both its legs reuse ONE compiled program whose batch
+donation is off because leg 1 replays staged batches.)  Until then
+the donation is asserted structurally by the lowering tests
+(tests/test_multi_step.py::TestStagedBatchDonation).
+
+Net expectation for the profile pair (fused epilogues + maxpool only,
+donation excluded): device-busy 46.9 -> 44.6-45.9 ms/step
+(~2 570 -> ~2 630-2 700 img/s/chip at b=128), convs unchanged at
+~93% of their HBM-implied ceiling.  Anything outside these ranges
+means the model of the step is wrong somewhere — find where before
+believing the number.
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    e = sub.add_parser("emit")
+    e.add_argument("--out",
+                   default=os.path.join(REPO, "artifacts",
+                                        "queue_xla_sweep_exps.json"))
+    e.add_argument("--config", default=None,
+                   help="JSON {name: xla-flags} overriding the "
+                        "built-in SWEEPS")
+    r = sub.add_parser("report")
+    r.add_argument("before")
+    r.add_argument("after")
+    r.add_argument("--out", default=None)
+    x = sub.add_parser("expected")
+    x.add_argument("--out",
+                   default=os.path.join(REPO, "artifacts",
+                                        "xla_sweep_expected.md"))
+    args = ap.parse_args()
+
+    if args.cmd == "emit":
+        sweeps = None
+        if args.config:
+            with open(args.config) as fh:
+                sweeps = json.load(fh)
+        entries = build_entries(sweeps)
+        with open(args.out, "w") as fh:
+            json.dump(entries, fh, indent=1)
+        print(f"wrote {len(entries)} queue entries to {args.out}")
+        print(f"run with: python tools/run_tpu_queue.py --gate "
+              f"--exps-json {args.out}")
+        return 0
+    if args.cmd == "report":
+        with open(args.before) as fh:
+            before = json.load(fh)
+        with open(args.after) as fh:
+            after = json.load(fh)
+        rep = ab_report(before, after)
+        print_report(rep)
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(rep, fh, indent=1)
+            print(f"\nwrote {args.out}")
+        return 0
+    with open(args.out, "w") as fh:
+        fh.write(EXPECTED_MD)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
