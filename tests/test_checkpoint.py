@@ -1,6 +1,9 @@
 """utils/checkpoint.py unit semantics (the rule-level resume paths are
 covered in test_async_rules/test_bsp_training/test_multihost)."""
 
+import hashlib
+import os
+
 import numpy as np
 import pytest
 
@@ -61,3 +64,76 @@ def test_close_failure_chains_not_masks(tmp_path):
         pass  # close() happened to succeed; nothing to chain
     except Exception as e:
         assert isinstance(e.__context__, Boom), e.__context__
+
+
+# -- read-only mode (the serving-reader contract, docs/SERVING.md) ----------
+
+
+def _dir_state(root):
+    """(files → sha256, set of dirs): the byte-identity oracle."""
+    files, dirs = {}, set()
+    for r, ds, fs in os.walk(root):
+        for d in ds:
+            dirs.add(os.path.relpath(os.path.join(r, d), root))
+        for name in fs:
+            full = os.path.join(r, name)
+            with open(full, "rb") as f:
+                files[os.path.relpath(full, root)] = (
+                    hashlib.sha256(f.read()).hexdigest())
+    return files, dirs
+
+
+def test_read_only_load_leaves_dir_byte_identical(tmp_path):
+    """A serving reader's full verified load — fence, manifest
+    verification, restore — writes NOTHING: no manifests, no prunes,
+    no quarantine, no new files."""
+    ck = Checkpointer(str(tmp_path), async_save=False)
+    ck.save(0, {"w": np.arange(4.0)})
+    ck.save(1, {"w": np.arange(4.0) + 1})
+    ck.close()
+    before = _dir_state(tmp_path)
+
+    ro = Checkpointer(str(tmp_path), read_only=True)
+    assert ro.latest_epoch() == 1
+    assert ro.kept_epochs() == {0, 1}
+    epoch, payload = ro.restore_latest_verified()
+    assert epoch == 1
+    np.testing.assert_allclose(payload["w"], np.arange(4.0) + 1)
+    ro.close()
+    assert _dir_state(tmp_path) == before
+
+
+def test_read_only_refuses_writes_and_missing_dir(tmp_path):
+    ck = Checkpointer(str(tmp_path / "d"))
+    ck.save(0, {"x": np.ones(2)})
+    ck.close()
+    ro = Checkpointer(str(tmp_path / "d"), read_only=True)
+    with pytest.raises(RuntimeError, match="read-only"):
+        ro.save(1, {"x": np.ones(2)})
+    ro.close()
+    # a reader must not CREATE the writer's directory either
+    with pytest.raises(FileNotFoundError):
+        Checkpointer(str(tmp_path / "nope"), read_only=True)
+
+
+def test_read_only_falls_back_without_quarantine(tmp_path):
+    """A corrupt latest epoch: the reader restores the previous kept
+    epoch but moves NOTHING — quarantine is the owning writer's
+    prerogative (utils/checkpoint.quarantine_epoch read-only no-op)."""
+    from theanompi_tpu.resilience.recovery import find_step_dir
+    from theanompi_tpu.utils.checkpoint import _truncate_largest_file
+
+    ck = Checkpointer(str(tmp_path), async_save=False)
+    ck.save(0, {"w": np.arange(6.0)})
+    ck.save(1, {"w": np.arange(6.0) + 1})
+    ck.close()
+    _truncate_largest_file(find_step_dir(str(tmp_path), 1))
+    before = _dir_state(tmp_path)
+
+    ro = Checkpointer(str(tmp_path), read_only=True)
+    epoch, payload = ro.restore_latest_verified()
+    ro.close()
+    assert epoch == 0
+    np.testing.assert_allclose(payload["w"], np.arange(6.0))
+    assert _dir_state(tmp_path) == before  # corrupt files left in place
+    assert not os.path.isdir(tmp_path / "quarantine")

@@ -228,6 +228,78 @@ class TestBiasAct:
         assert set(vb["params"]) == {"bias"}
 
 
+class TestEvalModeParity:
+    """Serving (theanompi_tpu/serving) runs the EVAL path exclusively —
+    ``use_running_average=True``, stats frozen at whatever training
+    left them — which PR 3's oracles only pinned for the xla impl.
+    These pin pallas == xla on that path, with NON-TRIVIAL running
+    stats (the init zeros/ones would let a mean/var mix-up pass)."""
+
+    def _stats_vars(self, c=32, key=20):
+        return {
+            "params": {"scale": _rand(key, (c,)),
+                       "bias": _rand(key + 1, (c,))},
+            "batch_stats": {"mean": _rand(key + 2, (c,)),
+                            "var": jnp.abs(_rand(key + 3, (c,))) + 0.3},
+        }
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("with_res", [False, True])
+    def test_batchnormact_eval_pallas_matches_xla(self, dtype, with_res):
+        x = _rand(21, (4, 6, 6, 32), dtype)
+        res = _rand(22, (4, 6, 6, 32), dtype) if with_res else None
+        v = self._stats_vars()
+        outs = {}
+        for impl in ("xla", "pallas"):
+            mod = L.BatchNormAct(use_running_average=True, act="relu",
+                                 impl=impl, dtype=dtype)
+            # NOT mutable: the eval path must never touch the stats
+            outs[impl] = mod.apply(v, x, residual=res)
+        bf16 = dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(outs["pallas"], np.float32),
+            np.asarray(outs["xla"], np.float32),
+            # the folded affine (scale*rsqrt(var+eps) precomputed)
+            # reassociates the bf16 rounding vs normalize-then-scale;
+            # atol covers near-zero outputs at the relu knee, where
+            # one bf16 ulp (~8e-3 at |y|~1) dwarfs any rtol
+            rtol=2e-2 if bf16 else 1e-5, atol=1e-2 if bf16 else 1e-5)
+
+    def test_batchnormact_eval_leaves_stats_untouched(self):
+        """Both impls: applying with use_running_average=True and the
+        stats collection MUTABLE still writes back the input values —
+        a serving step can never drift the frozen statistics."""
+        x = _rand(23, (4, 6, 6, 32))
+        v = self._stats_vars()
+        for impl in ("xla", "pallas"):
+            mod = L.BatchNormAct(use_running_average=True, act="relu",
+                                 impl=impl)
+            _, upd = mod.apply(v, x, mutable=["batch_stats"])
+            for key in ("mean", "var"):
+                np.testing.assert_array_equal(
+                    np.asarray(upd["batch_stats"][key]),
+                    np.asarray(v["batch_stats"][key]))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_biasact_pallas_matches_xla_eval(self, dtype):
+        """BiasAct has no train/eval split of its own, but serving
+        runs it at the zoo's bf16 compute dtype — pin the impls
+        against each other there too."""
+        x = _rand(24, (2, 8, 8, 16), dtype)
+        b = _rand(25, (16,))
+        y_x = L.BiasAct(16, act="relu", impl="xla").apply(
+            {"params": {"bias": b}}, x)
+        y_p = L.BiasAct(16, act="relu", impl="pallas").apply(
+            {"params": {"bias": b}}, x)
+        bf16 = dtype == jnp.bfloat16
+        # bf16 atol: the xla path adds in bf16, the kernel in f32
+        # before the final cast — near-zero relu outputs differ by up
+        # to one bf16 ulp
+        np.testing.assert_allclose(
+            np.asarray(y_p, np.float32), np.asarray(y_x, np.float32),
+            rtol=2e-2 if bf16 else 1e-6, atol=1e-2 if bf16 else 1e-6)
+
+
 class TestModelSeam:
     def test_resnet_pallas_equals_xla_fwd_and_grad(self):
         """ResNet built with bn_act_impl='pallas' matches the 'xla'

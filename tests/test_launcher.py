@@ -17,7 +17,7 @@ def test_parser_rules_and_defaults():
     assert args.modelfile == "theanompi_tpu.models.cifar10"
     assert args.devices is None and args.epochs is None
     assert args.sync_type == "avg"
-    assert set(RULES) == {"BSP", "EASGD", "ASGD", "GOSGD"}
+    assert set(RULES) == {"BSP", "EASGD", "ASGD", "GOSGD", "SERVE"}
 
 
 def test_parser_rejects_unknown_rule(capsys):
@@ -35,6 +35,62 @@ def test_parser_overrides():
     assert (args.devices, args.epochs, args.batch_size) == (4, 3, 32)
     assert (args.lr, args.tau, args.alpha) == (0.05, 7, 0.25)
     assert args.sync_type == "cdd" and args.platform == "cpu"
+
+
+def test_parser_serve_mode():
+    """SERVE (theanompi_tpu/serving, docs/SERVING.md) rides the same
+    entry point; its knobs parse and the guards fire."""
+    from theanompi_tpu.launcher import _run
+
+    p = _build_parser(multihost=False)
+    args = p.parse_args(["SERVE", "--export-dir", "/tmp/exp",
+                         "--port", "45901", "--serve-replicas", "2",
+                         "--max-batch", "16", "--max-delay-ms", "2.5",
+                         "--serve-buckets", "1,4,16",
+                         "--max-queue", "64", "--reload-poll-s", "0.5"])
+    assert args.rule == "SERVE" and args.export_dir == "/tmp/exp"
+    assert (args.port, args.serve_replicas, args.max_batch) == (45901, 2, 16)
+    assert (args.max_delay_ms, args.serve_buckets) == (2.5, "1,4,16")
+    assert (args.max_queue, args.reload_poll_s) == (64, 0.5)
+    # --max-restarts default is None so each mode picks its own:
+    # training fail-fast (0), SERVE supervised recovery (2, matching
+    # serve_main) — the launcher must not silently disable serving's
+    # documented restart-from-export
+    assert args.max_restarts is None
+    # SERVE without an export dir fails fast, before touching jax
+    with pytest.raises(SystemExit, match="export-dir"):
+        _run(p.parse_args(["SERVE"]), multihost=False)
+    # and is single-host by construction
+    mp = _build_parser(multihost=True)
+    with pytest.raises(SystemExit, match="single-host"):
+        _run(mp.parse_args(["SERVE", "--coordinator", "h0:1",
+                            "--nhosts", "2", "--host-id", "0",
+                            "--export-dir", "/tmp/exp"]),
+             multihost=True)
+
+
+def test_serve_defaults_to_supervised_recovery(monkeypatch, tmp_path):
+    """tmlocal SERVE without --max-restarts must hand serve_main the
+    serving default (2), not training's fail-fast 0 — otherwise one
+    transient batch failure permanently loses the only replica."""
+    import theanompi_tpu.serving.server as srv
+    from theanompi_tpu.launcher import _run
+
+    seen = {}
+
+    def fake_serve_main(export_dir, **kw):
+        seen.update(kw, export_dir=export_dir)
+        return 0
+
+    monkeypatch.setattr(srv, "serve_main", fake_serve_main)
+    p = _build_parser(multihost=False)
+    _run(p.parse_args(["SERVE", "--export-dir", str(tmp_path)]),
+         multihost=False)
+    assert seen["max_restarts"] == 2
+    # an explicit value still wins
+    _run(p.parse_args(["SERVE", "--export-dir", str(tmp_path),
+                       "--max-restarts", "5"]), multihost=False)
+    assert seen["max_restarts"] == 5
 
 
 def test_parser_multihost_requires_coordination():

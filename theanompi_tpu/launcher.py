@@ -27,7 +27,9 @@ import sys
 
 from theanompi_tpu.models import MODEL_ZOO
 
-RULES = ("BSP", "EASGD", "ASGD", "GOSGD")
+#: SERVE is the inference mode (theanompi_tpu/serving, docs/SERVING.md)
+#: — same entry point so one operator surface covers train AND serve
+RULES = ("BSP", "EASGD", "ASGD", "GOSGD", "SERVE")
 
 
 def _build_parser(multihost: bool) -> argparse.ArgumentParser:
@@ -101,7 +103,11 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="write the session result (val metrics + scalar "
                         "rule stats, e.g. GOSGD gossip weights, EASGD "
                         "n_exchanges) as JSON — param trees are omitted")
-    p.add_argument("--max-restarts", type=int, default=0, metavar="N",
+    # default None: training resolves to 0 (the reference's fail-fast
+    # behavior), SERVE to 2 (serving defaults to supervised recovery —
+    # serve_main and `python -m ...serving.server` already do; the
+    # launcher must not silently disable it)
+    p.add_argument("--max-restarts", type=int, default=None, metavar="N",
                    help="resilience (docs/RESILIENCE.md): async rules "
                         "restart a crashed worker thread from the center "
                         "params up to N times (quorum-bounded); under "
@@ -112,13 +118,43 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                         "auto-resume is single-host only — one host of "
                         "a tmlauncher SPMD program cannot rejoin the "
                         "collectives its peers are mid-flight in. "
-                        "0 = the reference's fail-fast behavior")
+                        "0 = the reference's fail-fast behavior.  "
+                        "SERVE: per-replica restart-from-export budget "
+                        "(docs/SERVING.md)")
     p.add_argument("--fault-plan", default=None, metavar="PATH|JSON",
                    help="activate the deterministic fault-injection "
                         "plane with this plan (a JSON file path or "
                         "inline JSON; docs/RESILIENCE.md); equivalent "
                         "to setting THEANOMPI_TPU_FAULTS — exported so "
                         "subprocesses inherit it")
+    p.add_argument("--export-dir", default=None, metavar="DIR",
+                   help="SERVE: versioned model-export directory "
+                        "(serving/export.py export_model writes it; "
+                        "required for the SERVE rule, which watches it "
+                        "for new versions to hot-reload)")
+    p.add_argument("--port", type=int, default=None,
+                   help="SERVE: listen port (default 45900)")
+    p.add_argument("--serve-host", default="0.0.0.0",
+                   help="SERVE: listen address")
+    p.add_argument("--serve-replicas", type=int, default=1,
+                   help="SERVE: inference replica count (each with its "
+                        "own queue + batcher)")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="SERVE: max rows per coalesced batch")
+    p.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="SERVE: max wait for a batch to fill before it "
+                        "dispatches anyway")
+    p.add_argument("--serve-buckets", default=None, metavar="N,N,...",
+                   help="SERVE: padded batch sizes (pre-compiled "
+                        "shapes; default powers of two up to "
+                        "--max-batch)")
+    p.add_argument("--max-queue", type=int, default=32,
+                   help="SERVE: admission bound — pending requests "
+                        "beyond this are rejected with Overloaded "
+                        "instead of queued (docs/SERVING.md)")
+    p.add_argument("--reload-poll-s", type=float, default=1.0,
+                   help="SERVE: export-dir poll interval for hot "
+                        "reload (0 disables the watcher)")
     p.add_argument("--monitor-dir", default=None, metavar="DIR",
                    help="enable the telemetry subsystem and write its "
                         "artifacts (metrics snapshot JSONL + Prometheus "
@@ -210,6 +246,28 @@ def _run(args, multihost: bool) -> int:
         # must land before the first backend touch; env alone can be
         # overridden by site customizations that pre-register plugins
         jax.config.update("jax_platforms", args.platform)
+    if args.rule == "SERVE":
+        # inference mode (theanompi_tpu/serving): no rule session, no
+        # model resolution — the export's metadata names the model
+        if multihost:
+            raise SystemExit("SERVE is single-host (run one server per "
+                             "host behind your load balancer)")
+        if not args.export_dir:
+            raise SystemExit("SERVE requires --export-dir (see "
+                             "serving/export.py export_model)")
+        from theanompi_tpu.serving.server import DEFAULT_PORT, serve_main
+
+        buckets = (tuple(int(b) for b in args.serve_buckets.split(","))
+                   if args.serve_buckets else None)
+        return serve_main(
+            args.export_dir, host=args.serve_host,
+            port=args.port if args.port is not None else DEFAULT_PORT,
+            replicas=args.serve_replicas, max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms, buckets=buckets,
+            max_queue=args.max_queue,
+            max_restarts=(2 if args.max_restarts is None
+                          else args.max_restarts),
+            reload_poll_s=args.reload_poll_s)
     if multihost:
         import jax
 
@@ -275,7 +333,8 @@ def _run(args, multihost: bool) -> int:
     # host of a multi-host SPMD program resuming alone would issue
     # collectives its peers (blocked mid-all-reduce at a different
     # step) can never match — fail fast on every host instead.
-    session_restarts = 0 if multihost else args.max_restarts
+    session_restarts = (0 if multihost
+                        else (args.max_restarts or 0))
     attempts = 0
     while True:
         rule.init(**kwargs)

@@ -19,8 +19,13 @@ Spec fields:
     wired sites are ``worker_step`` (async-rule worker loops; coords
     ``rule``, ``worker``, ``step``), ``service_call``
     (``ServiceClient.call``; coord ``op``), ``checkpoint``
-    (``Checkpointer`` manifest sync; coord ``epoch``), and
-    ``exchange`` (the in-process parameter stores; coord ``kind``).
+    (``Checkpointer`` manifest sync; coord ``epoch``),
+    ``exchange`` (the in-process parameter stores; coord ``kind``),
+    and the serving pair (docs/SERVING.md): ``serve_step`` (one
+    replica batch execution; coords ``replica``, ``step`` — ``raise``
+    fails the batch and exercises restart-from-export, ``delay``
+    slows a replica so admission control trips) and ``serve_rpc``
+    (the inference server's per-request handler; coord ``op``).
 ``action``
     ``raise`` (default) raises :class:`FaultInjected` at the site;
     ``delay`` sleeps ``delay_s`` seconds (default 0.1) then lets the

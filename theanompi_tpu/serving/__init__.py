@@ -1,0 +1,52 @@
+"""theanompi_tpu.serving — dynamic-batching inference over exported
+checkpoints (docs/SERVING.md).
+
+The training stack ends at a checkpoint; this package is the other
+half of the north star ("serve heavy traffic"): freeze a trained
+model into a versioned, verified export (``export.py``), coalesce
+concurrent requests into padded bucket-shaped device batches
+(``batcher.py``), and answer them from a supervised multi-replica
+server with admission control and hot reload (``server.py``) behind
+the same authenticated wire transport the async rules use.
+
+    # trainer / exporter side
+    from theanompi_tpu.serving import export_model
+    export_model(model, "exports/cifar10")
+
+    # server:  tmlocal SERVE --export-dir exports/cifar10
+    # client
+    from theanompi_tpu.serving import InferenceClient
+    logits = InferenceClient("host:45900").infer(batch)
+"""
+
+from theanompi_tpu.serving.batcher import (
+    BatchPolicy,
+    DynamicBatcher,
+    Overloaded,
+    default_buckets,
+    pick_bucket,
+)
+from theanompi_tpu.serving.export import (
+    InferenceSession,
+    LoadedExport,
+    build_model_from_meta,
+    export_model,
+    latest_export_version,
+    load_export,
+)
+from theanompi_tpu.serving.server import (
+    DEFAULT_PORT,
+    InferenceClient,
+    InferenceServer,
+    Replica,
+    serve,
+    serve_main,
+)
+
+__all__ = [
+    "BatchPolicy", "DynamicBatcher", "Overloaded", "default_buckets",
+    "pick_bucket", "InferenceSession", "LoadedExport",
+    "build_model_from_meta", "export_model", "latest_export_version",
+    "load_export", "DEFAULT_PORT", "InferenceClient", "InferenceServer",
+    "Replica", "serve", "serve_main",
+]

@@ -1,0 +1,369 @@
+"""Dynamic request batching for the inference path.
+
+The serving throughput lever is the same one the training stack
+already optimizes: amortize per-dispatch overhead by putting MANY
+samples through ONE accelerator step (the TensorFlow system paper's
+serving story, arXiv:1605.08695 §4 — and the reason per-request
+inference wastes an MXU).  A :class:`DynamicBatcher` coalesces
+concurrent requests until either ``max_batch`` rows are pending or the
+OLDEST request has waited ``max_delay_ms`` — latency is bounded by the
+delay knob, throughput by the batch knob.
+
+**Buckets** (the no-recompile contract): every coalesced batch is
+padded up to one of a small fixed set of row counts
+(``BatchPolicy.buckets``, default powers of two up to ``max_batch``),
+so steady-state serving only ever presents ``len(buckets)`` distinct
+input shapes to the jitted inference fn — each compiles once (at
+warmup or on first use) and never again.  Padding rows are zeros;
+eval-mode inference is row-independent (BatchNorm uses running stats —
+tests/test_fused_bn.py eval-parity), so pad rows cannot perturb real
+rows and are simply sliced off the result.
+
+**Admission control** (overload semantics, docs/SERVING.md): the
+pending-request queue is bounded at ``max_queue``.  When it is full,
+``submit`` raises :class:`Overloaded` IMMEDIATELY instead of
+enqueueing — under sustained overload every accepted request keeps a
+bounded latency and the excess is rejected in O(1), rather than every
+request's latency collapsing as an unbounded queue grows.  The typed
+class name rides the service wire in the ``err`` reply prefix (the
+same mechanism as ``SessionDisplaced`` in parallel/service.py), so a
+remote client re-raises ``Overloaded`` rather than parsing prose.
+
+Telemetry (all strictly no-op when the monitor is disabled):
+``serving/request_ms`` (submit→result latency histogram),
+``serving/batch_rows`` / ``serving/batch_occupancy`` (dynamic batch
+formation), ``serving/queue_depth`` gauge, ``serving/overloaded_total``,
+``serving/padding_rows_total``, and a per-replica heartbeat gauge
+``serving/replica_heartbeat``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from theanompi_tpu import monitor
+
+
+class Overloaded(RuntimeError):
+    """Admission-control rejection: the queue is at capacity (or the
+    replica is dead).  Deliberately NOT retried by the transport —
+    the server answered, fast, and the correct reactions (client-side
+    backoff, load shedding, more replicas) live above the wire."""
+
+
+def default_buckets(max_batch: int) -> tuple[int, ...]:
+    """Powers of two up to ``max_batch`` (always included) — a handful
+    of compiled programs covering every occupancy."""
+    out = set()
+    b = 1
+    while b < max_batch:
+        out.add(b)
+        b *= 2
+    out.add(max_batch)
+    return tuple(sorted(out))
+
+
+def pick_bucket(rows: int, buckets: tuple[int, ...]) -> int:
+    """Smallest bucket >= rows (buckets sorted ascending)."""
+    for b in buckets:
+        if b >= rows:
+            return b
+    raise ValueError(f"{rows} rows exceed the largest bucket "
+                     f"{buckets[-1]}")
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchPolicy:
+    """Batching/admission knobs for one replica (docs/SERVING.md)."""
+
+    #: max rows per coalesced batch (= the largest bucket)
+    max_batch: int = 8
+    #: max time the OLDEST pending request waits for company before
+    #: the batch dispatches regardless of occupancy
+    max_delay_ms: float = 5.0
+    #: padded batch shapes (sorted ascending); None = powers of two up
+    #: to max_batch.  The largest bucket must equal max_batch.
+    buckets: tuple[int, ...] | None = None
+    #: admission bound: pending REQUESTS beyond this are rejected with
+    #: Overloaded instead of queued
+    max_queue: int = 32
+    #: a submitted request gives up after this long (a dead/wedged
+    #: replica must not hang its clients forever)
+    submit_timeout_s: float = 60.0
+
+    def resolved_buckets(self) -> tuple[int, ...]:
+        if self.buckets is None:
+            return default_buckets(self.max_batch)
+        bs = tuple(sorted(set(int(b) for b in self.buckets)))
+        if not bs or bs[0] < 1:
+            raise ValueError(f"invalid buckets {self.buckets!r}")
+        if bs[-1] != self.max_batch:
+            raise ValueError(
+                f"largest bucket {bs[-1]} != max_batch {self.max_batch} "
+                "— a full batch must have a shape to land in")
+        return bs
+
+
+class _Request:
+    __slots__ = ("x", "rows", "done", "result", "error", "t0")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.done = threading.Event()
+        self.result: np.ndarray | None = None
+        self.error: BaseException | None = None
+        self.t0 = time.monotonic()
+
+
+class DynamicBatcher:
+    """One replica's coalescing queue + collector thread.
+
+    ``run_batch(x_padded) -> y`` executes one padded batch (leading
+    dim is a bucket size); it is called from the collector thread
+    only, so it needs no locking of its own.  A batch-execution
+    exception fails THAT batch's requests (each ``submit`` re-raises
+    it) and is handed to ``on_batch_error``; if the hook returns
+    falsy the batcher marks itself dead — pending and future submits
+    are rejected with :class:`Overloaded` so the server routes around
+    the corpse (serving/server.py owns the restart-from-export
+    policy)."""
+
+    def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
+                 policy: BatchPolicy | None = None, replica: int = 0,
+                 on_batch_error: Callable[[BaseException], bool]
+                 | None = None):
+        self.policy = policy or BatchPolicy()
+        self.buckets = self.policy.resolved_buckets()
+        self.replica = int(replica)
+        self._run_batch = run_batch
+        self._on_batch_error = on_batch_error
+        self._q: deque[_Request] = deque()
+        self._qrows = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._dead = False
+        self._thread: threading.Thread | None = None
+        # plain-int stats (read without the lock — torn reads of a
+        # monotonically-increasing int are harmless for stats())
+        self.n_batches = 0
+        self.n_rows = 0
+        self.n_overloaded = 0
+        self.n_batch_errors = 0
+        self.max_occupancy = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "DynamicBatcher":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serving-batcher-{self.replica}")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        self._fail_pending(Overloaded(
+            f"replica {self.replica} is shutting down"))
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead and not self._stop.is_set()
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def stats(self) -> dict:
+        return {
+            "replica": self.replica,
+            "alive": self.alive,
+            "batches": self.n_batches,
+            "rows": self.n_rows,
+            "overloaded": self.n_overloaded,
+            "batch_errors": self.n_batch_errors,
+            "max_occupancy": self.max_occupancy,
+            "queue_depth": self.queue_depth(),
+        }
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, x: np.ndarray) -> np.ndarray:
+        """Enqueue one request (``x``: (rows, *sample)) and block for
+        its rows of the batched result.  Raises :class:`Overloaded`
+        on admission rejection, or re-raises the batch-execution
+        error that consumed this request."""
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] < 1:
+            raise ValueError(f"request needs a leading rows dim >= 1, "
+                             f"got shape {x.shape}")
+        if x.shape[0] > self.policy.max_batch:
+            raise ValueError(
+                f"request rows {x.shape[0]} exceed max_batch "
+                f"{self.policy.max_batch}; split the request")
+        req = _Request(x)
+        with self._cond:
+            if self._dead or self._stop.is_set():
+                self.n_overloaded += 1
+                monitor.inc("serving/overloaded_total",
+                            replica=self.replica)
+                raise Overloaded(
+                    f"replica {self.replica} is not serving")
+            if len(self._q) >= self.policy.max_queue:
+                self.n_overloaded += 1
+                monitor.inc("serving/overloaded_total",
+                            replica=self.replica)
+                raise Overloaded(
+                    f"replica {self.replica} queue is full "
+                    f"({self.policy.max_queue} pending); rejecting "
+                    "instead of queueing unboundedly")
+            self._q.append(req)
+            self._qrows += req.rows
+            monitor.set_gauge("serving/queue_depth", len(self._q),
+                              replica=self.replica)
+            self._cond.notify_all()
+        if not req.done.wait(self.policy.submit_timeout_s):
+            # reclaim the admission slot: an abandoned request must not
+            # keep counting against max_queue (starving live requests
+            # with Overloaded) nor burn a device batch nobody awaits.
+            # If the collector already popped it into an in-flight
+            # batch (ValueError below) it executes once regardless —
+            # there is no cancelling a dispatched batch.
+            with self._cond:
+                try:
+                    self._q.remove(req)
+                    self._qrows -= req.rows
+                    monitor.set_gauge("serving/queue_depth",
+                                      len(self._q),
+                                      replica=self.replica)
+                except ValueError:
+                    pass
+            raise TimeoutError(
+                f"request timed out after "
+                f"{self.policy.submit_timeout_s}s on replica "
+                f"{self.replica} (wedged batch?)")
+        if req.error is not None:
+            raise req.error
+        monitor.observe("serving/request_ms",
+                        (time.monotonic() - req.t0) * 1e3)
+        return req.result
+
+    # -- collector thread ---------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            group = self._collect()
+            if group:
+                self._execute(group)
+
+    def _collect(self) -> list[_Request]:
+        """Block for the first request, then hold the batch open until
+        ``max_batch`` rows are pending or the oldest request has
+        waited ``max_delay_ms``; pop whole requests up to the row
+        cap."""
+        max_rows = self.policy.max_batch
+        with self._cond:
+            while not self._q and not self._stop.is_set():
+                # bounded wait so the heartbeat stays fresh while idle
+                self._cond.wait(0.25)
+                monitor.set_gauge("serving/replica_heartbeat",
+                                  time.time(), replica=self.replica)
+            if self._stop.is_set():
+                return []
+            deadline = self._q[0].t0 + self.policy.max_delay_ms / 1e3
+            while self._qrows < max_rows and not self._stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            group: list[_Request] = []
+            rows = 0
+            while self._q and rows + self._q[0].rows <= max_rows:
+                req = self._q.popleft()
+                self._qrows -= req.rows
+                group.append(req)
+                rows += req.rows
+            monitor.set_gauge("serving/queue_depth", len(self._q),
+                              replica=self.replica)
+            return group
+
+    def _execute(self, group: list[_Request]) -> None:
+        rows = sum(r.rows for r in group)
+        bucket = pick_bucket(rows, self.buckets)
+        x = (group[0].x if len(group) == 1
+             else np.concatenate([r.x for r in group], axis=0))
+        if bucket > rows:
+            pad = np.zeros((bucket - rows, *x.shape[1:]), x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+            monitor.inc("serving/padding_rows_total", bucket - rows,
+                        replica=self.replica)
+        try:
+            out = np.asarray(self._run_batch(x))
+        except Exception as e:
+            self.n_batch_errors += 1
+            monitor.inc("serving/batch_errors_total",
+                        replica=self.replica)
+            for r in group:
+                r.error = e
+                r.done.set()
+            if self._on_batch_error is not None:
+                if not self._on_batch_error(e):
+                    self._mark_dead()
+            return
+        self.n_batches += 1
+        self.n_rows += rows
+        self.max_occupancy = max(self.max_occupancy, len(group))
+        monitor.observe("serving/batch_rows", rows,
+                        replica=self.replica)
+        monitor.observe("serving/batch_occupancy", rows / bucket,
+                        replica=self.replica)
+        monitor.inc("serving/batches_total", replica=self.replica)
+        monitor.set_gauge("serving/replica_heartbeat", time.time(),
+                          replica=self.replica)
+        off = 0
+        for r in group:
+            r.result = out[off:off + r.rows]
+            off += r.rows
+            r.done.set()
+
+    def _mark_dead(self) -> None:
+        with self._cond:
+            self._dead = True
+            self._cond.notify_all()
+        self._fail_pending(Overloaded(
+            f"replica {self.replica} died (restart budget exhausted)"))
+
+    def _fail_pending(self, err: BaseException) -> None:
+        with self._cond:
+            pending, self._q = list(self._q), deque()
+            self._qrows = 0
+        for r in pending:
+            if not r.done.is_set():
+                r.error = err
+                r.done.set()
+
+    # -- warmup ---------------------------------------------------------
+
+    def warmup(self, sample_shape: tuple[int, ...],
+               dtype: np.dtype, fn: Callable | None = None) -> None:
+        """Compile every bucket shape up front (zeros through
+        ``run_batch``), so steady-state serving never recompiles —
+        call BEFORE start() or from the server's init.  ``fn``
+        overrides the batch fn: the server passes the raw session so
+        warmup bypasses the ``serve_step`` fault site and the served-
+        batch counter — an injected fault must hit serving, not crash
+        construction before the port is even bound."""
+        fn = fn or self._run_batch
+        for b in self.buckets:
+            fn(np.zeros((b, *sample_shape), dtype))

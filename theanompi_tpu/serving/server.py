@@ -1,0 +1,495 @@
+"""Multi-replica inference server + wire client.
+
+The transport is the proven ``parallel/service.py`` pattern —
+``multiprocessing.connection`` length-prefixed pickle with HMAC auth
+(NO default key; ``THEANOMPI_TPU_SERVICE_KEY`` gates both ends), one
+handler thread per connection, typed error names riding the ``err``
+reply prefix — so everything learned there (reconnect-with-backoff
+clients, fast-failing server errors) carries over to serving.
+
+Topology: one :class:`InferenceServer` owns N :class:`Replica`\\ s.
+Each replica is an :class:`~theanompi_tpu.serving.export.InferenceSession`
+(its own jitted eval fn — on real hardware each would pin its own
+device) behind its own :class:`~theanompi_tpu.serving.batcher.DynamicBatcher`
+queue.  Requests round-robin over live replicas with overflow
+failover; when EVERY live replica's queue is full the request is
+rejected with :class:`Overloaded` — bounded queues, bounded latency
+(docs/SERVING.md).
+
+Resilience wiring: ``serve_rpc`` (per-request, in the connection
+handler) and ``serve_step`` (per-batch, in the replica) are fault
+sites (resilience.faults).  A batch-execution failure fails that
+batch's requests, then the replica is RESTARTED FROM THE EXPORT — a
+fresh verified load of the current version — up to ``max_restarts``
+times, after which the replica is lost and traffic routes around it
+(the quorum analogue: a server with zero live replicas rejects, it
+does not crash).
+
+Hot reload: a watcher polls the export directory for a newer version
+(meta-sidecar presence = completed publish); a new one is VERIFIED-loaded
+once and swapped into every replica atomically — in-flight batches
+finish on the old arrays, zero requests dropped
+(tests/test_serving.py pins this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+from multiprocessing.connection import Client, Connection, Listener
+from typing import Any
+
+import numpy as np
+
+from theanompi_tpu import monitor
+from theanompi_tpu.resilience import faults
+from theanompi_tpu.serving.batcher import (
+    BatchPolicy,
+    DynamicBatcher,
+    Overloaded,
+)
+from theanompi_tpu.serving.export import (
+    InferenceSession,
+    build_model_from_meta,
+    latest_export_version,
+    load_export,
+)
+
+PyTree = Any
+
+#: default port one above the param service's 45800 block
+DEFAULT_PORT = 45900
+
+
+class Replica:
+    """One inference session + batcher under restart supervision."""
+
+    def __init__(self, idx: int, export_dir: str, policy: BatchPolicy,
+                 loaded, model, max_restarts: int = 2,
+                 donate: bool = True):
+        self.idx = int(idx)
+        self.export_dir = export_dir
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._steps = 0
+        self.session = InferenceSession(
+            model, params=loaded.params, model_state=loaded.model_state,
+            version=loaded.version, donate=donate)
+        self.batcher = DynamicBatcher(
+            self._run_batch, policy, replica=self.idx,
+            on_batch_error=self._on_batch_error)
+
+    @property
+    def alive(self) -> bool:
+        return self.batcher.alive
+
+    def _run_batch(self, x: np.ndarray) -> np.ndarray:
+        self._steps += 1
+        faults.fire("serve_step", replica=self.idx, step=self._steps)
+        return self.session.infer(x)
+
+    def _on_batch_error(self, exc: BaseException) -> bool:
+        """Supervised recovery (resilience, docs/SERVING.md): reload
+        this replica's arrays from the export — a fresh VERIFIED read,
+        so a batch failure caused by in-memory corruption starts over
+        from known-good bytes.  Returns False (replica lost) once the
+        budget is spent."""
+        self.restarts += 1
+        monitor.inc("serving/replica_restarts_total", replica=self.idx)
+        if self.restarts > self.max_restarts:
+            print(f"[serving] replica {self.idx} exhausted "
+                  f"{self.max_restarts} restarts "
+                  f"({type(exc).__name__}: {exc}); marking it lost",
+                  flush=True)
+            return False
+        try:
+            loaded = load_export(self.export_dir)
+        except Exception as e:
+            print(f"[serving] replica {self.idx} restart-from-export "
+                  f"failed ({type(e).__name__}: {e}); marking it lost",
+                  flush=True)
+            return False
+        swapped = self.session.swap(loaded.version, loaded.params,
+                                    loaded.model_state)
+        print(f"[serving] replica {self.idx} restarted "
+              + (f"from export v{loaded.version}" if swapped else
+                 f"on v{self.session.version} (a concurrent hot "
+                 f"reload superseded the v{loaded.version} load)")
+              + f" after {type(exc).__name__} "
+              f"(restart {self.restarts}/{self.max_restarts})",
+              flush=True)
+        return True
+
+    def swap(self, version: int, params, model_state) -> None:
+        self.session.swap(version, params, model_state)
+
+
+class InferenceServer:
+    """Replica pool + admission + hot reload (module docstring)."""
+
+    def __init__(self, export_dir: str, replicas: int = 1,
+                 policy: BatchPolicy | None = None,
+                 max_restarts: int = 2, reload_poll_s: float = 1.0,
+                 warmup: bool = True, mesh=None, donate: bool = True,
+                 model=None):
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self.export_dir = os.path.abspath(export_dir)
+        self.policy = policy or BatchPolicy()
+        self.reload_poll_s = float(reload_poll_s)
+        loaded = load_export(self.export_dir)
+        # ONE model rebuild (module + config threading) shared by all
+        # replicas; each replica jits its own fn over the shared
+        # module.  ``model=`` skips the rebuild when the caller (a
+        # test, an embedded exporter-server) already holds the
+        # instance — the ARRAYS still come from the verified export.
+        self.model = (model if model is not None
+                      else build_model_from_meta(loaded.meta, mesh=mesh))
+        self.version = loaded.version
+        self.replicas = [
+            Replica(i, self.export_dir, self.policy, loaded, self.model,
+                    max_restarts=max_restarts, donate=donate)
+            for i in range(int(replicas))
+        ]
+        if warmup:
+            shape = tuple(loaded.meta.get("sample_shape")
+                          or self.model.data.sample_shape)
+            dtype = np.dtype(loaded.meta.get("sample_dtype") or
+                             np.float32)
+            for r in self.replicas:
+                # fn=session.infer: warmup compiles the same jitted fn
+                # but skips the serve_step fault site — a fault plan
+                # must take down served batches (supervised restart),
+                # not construction before the port is bound
+                r.batcher.warmup(shape, dtype, fn=r.session.infer)
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watcher: threading.Thread | None = None
+        self._reload_lock = threading.Lock()
+        #: newest published version that failed verification — skipped
+        #: by the reload poll until a strictly newer one appears
+        self._bad_newest: int | None = None
+        monitor.set_gauge("serving/model_version", self.version)
+        monitor.set_gauge("serving/replicas", len(self.replicas))
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        for r in self.replicas:
+            r.batcher.start()
+        if self.reload_poll_s > 0:
+            self._watcher = threading.Thread(
+                target=self._watch_reload, daemon=True,
+                name="serving-reload-watcher")
+            self._watcher.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for r in self.replicas:
+            r.batcher.stop()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+
+    # -- request path --------------------------------------------------
+
+    def submit(self, x: np.ndarray) -> np.ndarray:
+        """Route one request to a live replica (round-robin with
+        full-queue failover); Overloaded only when EVERY live replica
+        rejects."""
+        n = len(self.replicas)
+        with self._rr_lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % n
+        last: Overloaded | None = None
+        any_alive = False
+        for k in range(n):
+            r = self.replicas[(start + k) % n]
+            if not r.alive:
+                continue
+            any_alive = True
+            try:
+                return r.batcher.submit(x)
+            except Overloaded as e:
+                last = e
+        if not any_alive:
+            raise Overloaded("no live replicas (all lost); the server "
+                             "needs a restart or a good export")
+        raise last if last is not None else Overloaded("rejected")
+
+    # -- hot reload ----------------------------------------------------
+
+    def check_reload(self) -> int:
+        """One poll: load + swap if a newer version is published;
+        returns the serving version either way.  Safe to call
+        concurrently (watcher + the ``reload`` RPC)."""
+        with self._reload_lock:
+            newest = latest_export_version(self.export_dir)
+            if (newest is None or newest <= self.version
+                    or newest == self._bad_newest):
+                return self.version
+            loaded = load_export(self.export_dir)
+            if loaded.version <= self.version:
+                # the newest manifest is on disk but its files did not
+                # verify (restore_latest_verified fell back, possibly
+                # to what we already serve).  Versions are immutable
+                # (export_model refuses re-export), so retrying the
+                # same corrupt version every poll is pure disk/CPU
+                # churn — remember it and wait for a strictly newer
+                # manifest to reset the skip.
+                self._bad_newest = newest
+                return self.version
+            self._bad_newest = None
+            for r in self.replicas:
+                r.swap(loaded.version, loaded.params,
+                       loaded.model_state)
+            old, self.version = self.version, loaded.version
+            monitor.set_gauge("serving/model_version", self.version)
+            monitor.inc("serving/reloads_total")
+            print(f"[serving] hot reload v{old} -> v{self.version} "
+                  f"({len(self.replicas)} replicas, in-flight "
+                  "requests kept)", flush=True)
+            return self.version
+
+    def _watch_reload(self) -> None:
+        while not self._stop.wait(self.reload_poll_s):
+            try:
+                self.check_reload()
+            except Exception as e:
+                # a broken half-published export must not kill the
+                # watcher; next poll retries
+                print(f"[serving] reload check failed: "
+                      f"{type(e).__name__}: {e}", flush=True)
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        reps = [dict(r.batcher.stats(), restarts=r.restarts,
+                     version=r.session.version)
+                for r in self.replicas]
+        return {
+            "version": self.version,
+            "replicas": reps,
+            "batches": sum(r["batches"] for r in reps),
+            "rows": sum(r["rows"] for r in reps),
+            "overloaded": sum(r["overloaded"] for r in reps),
+            "max_occupancy": max((r["max_occupancy"] for r in reps),
+                                 default=0),
+            "live_replicas": sum(1 for r in self.replicas if r.alive),
+        }
+
+    # -- wire dispatch ---------------------------------------------------
+
+    def handle(self, op: str, *args):
+        if op == "infer":
+            (x,) = args
+            return self.submit(np.asarray(x))
+        if op == "stats":
+            return self.stats()
+        if op == "reload":
+            return self.check_reload()
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown op {op!r}")
+
+
+def serve(server: InferenceServer, host: str = "0.0.0.0",
+          port: int = DEFAULT_PORT,
+          ready_event: threading.Event | None = None,
+          stop_event: threading.Event | None = None,
+          authkey: bytes | None = None) -> None:
+    """Accept loop (one handler thread per connection) until a
+    ``shutdown`` op or ``stop_event`` — the parallel/service.py shape,
+    with the serving ops and the ``serve_rpc`` fault site."""
+    from theanompi_tpu.parallel.service import _authkey
+
+    if stop_event is None:
+        stop_event = threading.Event()
+    if authkey is None:
+        authkey = _authkey(generate=True)
+    listener = Listener((host, port), authkey=authkey)
+    if ready_event is not None:
+        ready_event.set()
+
+    def handle_conn(conn: Connection):
+        monitor.add_gauge("serving/clients", 1.0)
+        try:
+            with conn:
+                while True:
+                    try:
+                        msg = conn.recv()
+                    except (EOFError, OSError):
+                        return
+                    if not isinstance(msg, tuple) or not msg:
+                        monitor.inc("serving/errors_total",
+                                    op="malformed")
+                        conn.send(("err", "malformed request"))
+                        continue
+                    op, *args = msg
+                    if op == "shutdown":
+                        conn.send(("ok", None))
+                        stop_event.set()
+                        try:  # unblock accept() so the loop exits
+                            Client((host if host != "0.0.0.0"
+                                    else "127.0.0.1", port),
+                                   authkey=authkey).close()
+                        except OSError:
+                            pass
+                        return
+                    t0 = time.monotonic()
+                    try:
+                        # fault plane: 'raise' rejects this RPC (the
+                        # client sees the typed err), 'delay' adds
+                        # latency — both exercised with the server
+                        # LIVE, which is the point
+                        faults.fire("serve_rpc", op=op)
+                        result = server.handle(op, *args)
+                    except Exception as e:  # surfaced client-side
+                        monitor.inc("serving/errors_total", op=op)
+                        conn.send(("err", f"{type(e).__name__}: {e}"))
+                        continue
+                    try:
+                        conn.send(("ok", result))
+                    except (EOFError, OSError):
+                        return  # peer gone; nothing to tell it
+                    except Exception as e:
+                        # reply failed to SERIALIZE (send pickles
+                        # before writing, so no bytes hit the wire
+                        # yet) — the client must still get a
+                        # diagnostic, not a bare EOFError
+                        # (parallel/service.py's loop has the same
+                        # branch)
+                        monitor.inc("serving/errors_total", op=op)
+                        conn.send(("err", f"{type(e).__name__}: {e}"))
+                        continue
+                    monitor.inc("serving/requests_total", op=op)
+                    monitor.observe("serving/rpc_ms",
+                                    (time.monotonic() - t0) * 1e3,
+                                    op=op)
+                    monitor.progress(phase="serving")
+        finally:
+            monitor.add_gauge("serving/clients", -1.0)
+
+    from multiprocessing import AuthenticationError
+
+    with listener:
+        while not stop_event.is_set():
+            try:
+                conn = listener.accept()
+            except AuthenticationError:
+                continue  # a bad-key peer must not kill the server
+            except OSError:
+                if stop_event.is_set():
+                    return
+                raise
+            threading.Thread(target=handle_conn, args=(conn,),
+                             daemon=True).start()
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+from theanompi_tpu.parallel.service import ServiceClient, ServiceError
+
+
+class InferenceClient(ServiceClient):
+    """Wire client: transport failures reconnect-with-backoff
+    (``infer`` is pure, so at-least-once is safe); server-side errors
+    fail fast, with :class:`Overloaded` re-raised as its own type off
+    the typed err-prefix (never retried by the transport — backoff
+    or shed ABOVE the wire)."""
+
+    def infer(self, x) -> np.ndarray:
+        try:
+            return self.call("infer", np.asarray(x))
+        except ServiceError as e:
+            if Overloaded.__name__ in str(e):
+                raise Overloaded(str(e)) from None
+            raise
+
+    def stats(self) -> dict:
+        return self.call("stats")
+
+    def reload(self) -> int:
+        """Force an immediate export-dir poll; returns the serving
+        version after it."""
+        return int(self.call("reload"))
+
+    def shutdown(self) -> None:
+        self.call("shutdown")
+
+
+# ---------------------------------------------------------------------------
+# Entry point (the launcher's SERVE mode lands here)
+# ---------------------------------------------------------------------------
+
+
+def serve_main(export_dir: str, host: str = "0.0.0.0",
+               port: int = DEFAULT_PORT, replicas: int = 1,
+               max_batch: int = 8, max_delay_ms: float = 5.0,
+               buckets: tuple[int, ...] | None = None,
+               max_queue: int = 32, max_restarts: int = 2,
+               reload_poll_s: float = 1.0) -> int:
+    policy = BatchPolicy(max_batch=max_batch, max_delay_ms=max_delay_ms,
+                         buckets=buckets, max_queue=max_queue)
+    # serving telemetry mirrors the param service's: request-driven
+    # progress, so the stall watchdog is off; name-suffixed files so a
+    # co-located trainer's rank0 files survive
+    with monitor.session(stall_after=float("inf"),
+                         name=f"serve{os.getpid()}"):
+        monitor.progress(phase="serving")
+        server = InferenceServer(
+            export_dir, replicas=replicas, policy=policy,
+            max_restarts=max_restarts, reload_poll_s=reload_poll_s)
+        server.start()
+        print(f"[serving] v{server.version} x{replicas} replicas on "
+              f"{host}:{port} (max_batch={max_batch}, "
+              f"max_delay={max_delay_ms}ms, "
+              f"buckets={server.policy.resolved_buckets()}, "
+              f"max_queue={max_queue})", flush=True)
+        try:
+            serve(server, host, port)
+        finally:
+            server.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="theanompi-tpu dynamic-batching inference server")
+    ap.add_argument("--export-dir", required=True)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated padded batch sizes "
+                         "(default: powers of two up to max-batch)")
+    ap.add_argument("--max-queue", type=int, default=32)
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--reload-poll-s", type=float, default=1.0)
+    ap.add_argument("--platform", default=None,
+                    help="jax platform (e.g. 'cpu')")
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    buckets = (tuple(int(b) for b in args.buckets.split(","))
+               if args.buckets else None)
+    return serve_main(args.export_dir, args.host, args.port,
+                      replicas=args.replicas, max_batch=args.max_batch,
+                      max_delay_ms=args.max_delay_ms, buckets=buckets,
+                      max_queue=args.max_queue,
+                      max_restarts=args.max_restarts,
+                      reload_poll_s=args.reload_poll_s)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

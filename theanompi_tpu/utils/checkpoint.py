@@ -46,12 +46,29 @@ class Checkpointer:
     the previous write is fenced at the start of the next ``save``, in
     ``restore``/``latest_epoch``/``kept_epochs``, and in ``close``.
     Pass ``async_save=False`` for the reference's fully-synchronous
-    per-epoch semantics."""
+    per-epoch semantics.
+
+    ``read_only=True`` is the SERVING-READER mode (docs/SERVING.md): a
+    process that only ever loads — an inference server watching a
+    trainer's (or exporter's) directory — must not contend with the
+    writer or mutate anything it reads.  A read-only Checkpointer
+    refuses ``save``, writes no manifests and prunes none, and its
+    ``quarantine_epoch`` is a no-op (``restore_latest_verified`` then
+    falls back PAST a corrupt epoch but leaves the corrupt files in
+    place for the owning writer to deal with).  A serving load leaves
+    the directory byte-identical — pinned by
+    tests/test_checkpoint.py::test_read_only_load_leaves_dir_byte_identical."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = True, integrity: bool = True,
-                 retry: RetryPolicy | None = None):
+                 retry: RetryPolicy | None = None,
+                 read_only: bool = False):
         self.directory = os.path.abspath(directory)
+        self.read_only = read_only
+        if read_only and not os.path.isdir(self.directory):
+            raise FileNotFoundError(
+                f"read-only Checkpointer: {self.directory} does not "
+                "exist (a reader must not create the writer's dir)")
         self.async_save = async_save
         self.integrity = integrity
         # transient-I/O retry on the RESTORE read path (a shared-
@@ -76,14 +93,19 @@ class Checkpointer:
         self._manifest_q: _queue.Queue = _queue.Queue()
         self._manifest_thread: threading.Thread | None = None
         self._max_to_keep = max_to_keep
-        os.makedirs(self.directory, exist_ok=True)
+        if not read_only:
+            os.makedirs(self.directory, exist_ok=True)
         self._mgr = self._make_manager()
 
     def _make_manager(self) -> ocp.CheckpointManager:
         return ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=self._max_to_keep, create=True
+                max_to_keep=self._max_to_keep,
+                # a reader must not create (or otherwise touch) the
+                # writer's directory; max_to_keep pruning only happens
+                # on save, which read-only mode refuses
+                create=not self.read_only,
             ),
         )
 
@@ -98,7 +120,7 @@ class Checkpointer:
             raise RuntimeError(
                 f"background checkpoint write to {self.directory} "
                 f"failed: {e}") from e
-        if self.integrity:
+        if self.integrity and not self.read_only:
             self._sync_manifests()
 
     def _sync_manifests(self) -> None:
@@ -155,10 +177,14 @@ class Checkpointer:
     def _drain_manifests(self) -> None:
         """Block until every queued manifest is on disk — called where
         manifests are consumed, never on the per-epoch save path."""
-        if self.integrity:
+        if self.integrity and not self.read_only:
             self._manifest_q.join()
 
     def save(self, epoch: int, payload: PyTree, force: bool = False) -> None:
+        if self.read_only:
+            raise RuntimeError(
+                f"Checkpointer({self.directory!r}) is read-only "
+                "(serving reader); refusing save")
         self._fence()  # fence any in-flight write
 
         # np.array (not asarray): device arrays copy either way, but a
@@ -230,7 +256,11 @@ class Checkpointer:
                 self._mgr.restore, epoch,
                 args=ocp.args.StandardRestore(like),
                 site="checkpoint/restore")
+        # template-less restore still names the handler explicitly: a
+        # FRESH manager (reopened dir, read-only serving reader) has no
+        # registry entry from a prior save and would otherwise refuse
         return self._retry.call(self._mgr.restore, epoch,
+                                args=ocp.args.StandardRestore(),
                                 site="checkpoint/restore")
 
     def quarantine_epoch(self, epoch: int) -> str | None:
@@ -240,7 +270,14 @@ class Checkpointer:
         existing step — and (b) no later manifest pass re-blesses the
         corrupt files.  Recreates the manager so its step cache
         forgets the quarantined epoch.  Returns the quarantine path
-        (None when there was nothing to move)."""
+        (None when there was nothing to move).
+
+        Read-only mode: a no-op returning None — the serving reader's
+        ``restore_latest_verified`` still falls back past the corrupt
+        epoch (recovery.py treats None as 'left in place'), but only
+        the owning WRITER may move its files."""
+        if self.read_only:
+            return None
         step_dir = recovery.find_step_dir(self.directory, epoch)
         if step_dir is None:
             return None

@@ -1,0 +1,246 @@
+"""Serving load generator — closed- and open-loop, against a live
+server or a self-contained in-process one.
+
+Closed loop (``--mode closed``): N client threads each send
+back-to-back requests for ``--duration`` seconds — measures the
+server's saturated throughput and the latency it buys (more clients →
+bigger coalesced batches → higher throughput per accelerator step).
+
+Open loop (``--mode open``): requests arrive on a Poisson clock at
+``--rate`` req/s regardless of completions — the honest
+heavy-traffic model (arrivals don't wait for the server), so latency
+includes queueing and the admission controller's ``Overloaded``
+rejections are counted instead of letting the queue grow without
+bound.
+
+Emits one ``BENCH_serving`` JSON (throughput, latency p50/p95/p99,
+batch occupancy from the server's own stats, overload counts) to
+``--out`` and prints it — same artifact discipline as the other bench
+tools.
+
+Usage:
+    # against a running server (tmlocal SERVE ...):
+    python tools/bench_serving.py --addr host:45900 --mode open --rate 200
+
+    # self-contained (exports a tiny model, serves in-process, drives it):
+    JAX_PLATFORMS=cpu python tools/bench_serving.py --demo --mode closed
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _bootstrap  # noqa: F401,E402  (makes JAX_PLATFORMS effective)
+import numpy as np  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _percentiles(ms: list[float]) -> dict:
+    if not ms:
+        return {}
+    a = np.sort(np.asarray(ms))
+    pick = lambda q: float(a[min(len(a) - 1, int(q * len(a)))])
+    return {"mean": float(a.mean()), "p50": pick(0.50),
+            "p95": pick(0.95), "p99": pick(0.99), "max": float(a[-1])}
+
+
+def _demo_export(tmp_dir: str) -> str:
+    """Export an untrained TinyCifar so the tool runs anywhere."""
+    from tests._tiny_models import TinyCifar
+    from theanompi_tpu.models.base import ModelConfig
+    from theanompi_tpu.serving import export_model
+
+    model = TinyCifar(config=ModelConfig(batch_size=8, n_epochs=1,
+                                         print_freq=0), verbose=False)
+    export_dir = os.path.join(tmp_dir, "export")
+    export_model(model, export_dir, version=0)
+    return export_dir
+
+
+def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
+             rate: float, duration: float) -> dict:
+    from theanompi_tpu.serving import InferenceClient, Overloaded
+
+    lock = threading.Lock()
+    lat_ms: list[float] = []
+    counts = {"ok": 0, "overloaded": 0, "errors": 0}
+
+    def one(client) -> None:
+        t0 = time.monotonic()
+        try:
+            client.infer(sample)
+        except Overloaded:
+            with lock:
+                counts["overloaded"] += 1
+            return
+        except Exception:
+            with lock:
+                counts["errors"] += 1
+            return
+        dt = (time.monotonic() - t0) * 1e3
+        with lock:
+            counts["ok"] += 1
+            lat_ms.append(dt)
+
+    t_start = time.monotonic()
+    if mode == "closed":
+        def worker():
+            client = InferenceClient(addr)
+            while time.monotonic() - t_start < duration:
+                one(client)
+            client.close()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:  # open loop: Poisson arrivals, one short-lived thread each
+        rng = np.random.default_rng(0)
+        pool = [InferenceClient(addr) for _ in range(clients)]
+        inflight: list[threading.Thread] = []
+        i = 0
+        next_t = t_start
+        while time.monotonic() - t_start < duration:
+            next_t += float(rng.exponential(1.0 / rate))
+            delay = next_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=one, args=(pool[i % clients],))
+            t.start()
+            inflight.append(t)
+            i += 1
+        for t in inflight:
+            t.join()
+        for c in pool:
+            c.close()
+    wall = time.monotonic() - t_start
+    return {"wall_s": wall, "latency_ms": _percentiles(lat_ms),
+            **counts,
+            "throughput_rps": counts["ok"] / wall if wall else 0.0}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--addr", default=None,
+                    help="host:port of a running server; omitted = "
+                         "serve --export-dir in-process")
+    ap.add_argument("--export-dir", default=None)
+    ap.add_argument("--demo", action="store_true",
+                    help="export an untrained TinyCifar to a temp dir "
+                         "first (self-contained CPU run)")
+    ap.add_argument("--mode", choices=("closed", "open"),
+                    default="closed")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="open-loop arrival rate, req/s")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="rows per request")
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=32)
+    ap.add_argument("--out", default="BENCH_serving.json")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    from theanompi_tpu.serving import (
+        BatchPolicy,
+        InferenceClient,
+        InferenceServer,
+        load_export,
+        serve,
+    )
+
+    tmp_ctx = tempfile.TemporaryDirectory()
+    server = thread = None
+    try:
+        if args.addr is None:
+            export_dir = args.export_dir
+            if export_dir is None:
+                if not args.demo:
+                    ap.error("need --addr, --export-dir, or --demo")
+                export_dir = _demo_export(tmp_ctx.name)
+            policy = BatchPolicy(max_batch=args.max_batch,
+                                 max_delay_ms=args.max_delay_ms,
+                                 max_queue=args.max_queue)
+            server = InferenceServer(export_dir,
+                                     replicas=args.replicas,
+                                     policy=policy).start()
+            port = _free_port()
+            ready = threading.Event()
+            thread = threading.Thread(
+                target=serve, args=(server, "127.0.0.1", port, ready),
+                daemon=True)
+            thread.start()
+            assert ready.wait(30), "server never came up"
+            addr = f"127.0.0.1:{port}"
+            meta = load_export(export_dir).meta
+        else:
+            addr = args.addr
+            if args.export_dir:
+                meta = load_export(args.export_dir).meta
+            else:
+                meta = {}
+        shape = tuple(meta.get("sample_shape") or (32, 32, 3))
+        dtype = np.dtype(meta.get("sample_dtype") or "uint8")
+        sample = np.zeros((args.rows, *shape), dtype)
+
+        probe = InferenceClient(addr)
+        probe.infer(sample)  # one warm request outside the window
+        result = run_load(addr, sample, args.mode, args.clients,
+                          args.rate, args.duration)
+        stats = probe.stats()
+        if server is not None:
+            probe.shutdown()
+        probe.close()
+        out = {
+            "bench": "serving",
+            "mode": args.mode,
+            "clients": args.clients,
+            "rate_rps": args.rate if args.mode == "open" else None,
+            "rows_per_request": args.rows,
+            "server": {
+                "addr": addr,
+                "version": stats.get("version"),
+                "replicas": stats.get("live_replicas"),
+                "batches": stats.get("batches"),
+                "batch_rows": stats.get("rows"),
+                "max_occupancy": stats.get("max_occupancy"),
+                "mean_occupancy": (stats["rows"] / stats["batches"]
+                                   if stats.get("batches") else None),
+                "overloaded": stats.get("overloaded"),
+            },
+            **result,
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out, indent=1))
+        print(f"BENCH_serving written to {args.out}")
+        return 0
+    finally:
+        if server is not None:
+            server.stop()
+        tmp_ctx.cleanup()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

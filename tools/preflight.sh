@@ -116,7 +116,78 @@ PYEOF
   RESILIENCE_RC=$?
   rm -rf "$FAULTDIR"
   echo "resilience smoke rc=$RESILIENCE_RC"
-  if [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ]; then
+  echo "## serving smoke (export -> server -> concurrent clients, docs/SERVING.md)"
+  # the serving vertical end-to-end on CPU: export an untrained tiny
+  # model, serve it on a real socket, fire concurrent clients; at
+  # least one multi-request batch must form and the request-latency
+  # histogram must land in the monitor JSONL
+  SERVEDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$SERVEDIR" python - <<'PYEOF'
+import glob, json, os, socket, threading
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tests._tiny_models import TinyCifar
+from theanompi_tpu import monitor
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.serving import (BatchPolicy, InferenceClient,
+                                   InferenceServer, export_model, serve)
+
+mondir = os.environ["THEANOMPI_TPU_MONITOR"]
+model = TinyCifar(config=ModelConfig(batch_size=8, n_epochs=1,
+                                     print_freq=0), verbose=False)
+export_dir = os.path.join(mondir, "export")
+export_model(model, export_dir, version=0)
+with monitor.session(run_dir=mondir, stall_after=float("inf")):
+    server = InferenceServer(
+        export_dir, replicas=1, reload_poll_s=0, model=model,
+        policy=BatchPolicy(max_batch=4, max_delay_ms=50.0,
+                           buckets=(4,), max_queue=16)).start()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ready = threading.Event()
+    t = threading.Thread(target=serve,
+                         args=(server, "127.0.0.1", port, ready),
+                         daemon=True)
+    t.start()
+    assert ready.wait(30)
+    x = np.asarray(model.data.x_val[:8])
+    outs = [None] * 8
+    clients = [InferenceClient(f"127.0.0.1:{port}") for _ in range(8)]
+    ths = [threading.Thread(
+        target=lambda i=i: outs.__setitem__(
+            i, clients[i].infer(x[i:i + 1]))) for i in range(8)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    st = clients[0].stats()
+    assert st["max_occupancy"] > 1, f"no dynamic batch formed: {st}"
+    assert all(o is not None and o.shape == (1, 10) for o in outs)
+    clients[0].shutdown()
+    for c in clients:
+        c.close()
+    t.join(timeout=5)
+    server.stop()
+snap = [p for p in glob.glob(os.path.join(mondir, "metrics_rank0.jsonl"))]
+recs = [json.loads(l) for l in open(snap[0])]
+names = {r["name"] for r in recs}
+missing = {"serving/request_ms", "serving/batch_occupancy",
+           "serving/requests_total"} - names
+assert not missing, f"snapshot missing serving series: {missing}"
+lat = next(r for r in recs if r["name"] == "serving/request_ms")
+assert lat["count"] == 8 and "p99" in lat, lat
+print(f"serving smoke OK: occupancy_max={st['max_occupancy']}, "
+      f"{st['batches']} batches / {st['rows']} rows, "
+      f"request p99 {lat['p99']:.1f}ms in monitor JSONL")
+PYEOF
+  SERVING_RC=$?
+  rm -rf "$SERVEDIR"
+  echo "serving smoke rc=$SERVING_RC"
+  if [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
     exit 1
