@@ -1,0 +1,240 @@
+"""Comm/compute overlap for the async rules (ISSUE 5 tentpole,
+rules/async_rules._ExchangePipe): the worker computes iteration i+1
+while iteration i's exchange RPC is in flight, bounded staleness 1.
+
+The acceptance bar: monitor spans DEMONSTRATE the overlap — the
+worker's compute span no longer encloses (or waits out) the exchange
+RPC span, witnessed live via ``monitor.open_spans()`` on the 8-dev
+CPU mesh — and an injected fault on the exchange path still lands
+exactly like a synchronous failure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theanompi_tpu import monitor
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.resilience import faults
+from theanompi_tpu.rules.async_rules import _ExchangePipe
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def tiny_cfg(tmp_path, **kw):
+    base = dict(batch_size=8, n_epochs=1, learning_rate=0.01,
+                snapshot_dir=str(tmp_path), print_freq=0)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+class TestExchangePipe:
+    def test_overlap_hides_rpc_behind_compute(self, tmp_path):
+        """With compute time ~ RPC time, the worker's collect wait is
+        a small fraction of the RPC span, and session wall-clock is
+        ~max(compute, rpc) per round, not the sum — the overlap the
+        reference built its exchanger around."""
+        rpc_s = compute_s = 0.15
+        rounds = 3
+        with monitor.session(str(tmp_path)):
+            pipe = _ExchangePipe(
+                lambda p: (time.sleep(rpc_s), p)[1], "test/exchange", 0)
+            try:
+                t0 = time.monotonic()
+                for i in range(rounds):
+                    pipe.submit({"x": i})
+                    time.sleep(compute_s)  # the overlapped compute
+                    with monitor.span("test/exchange_collect",
+                                      worker="0"):
+                        payload, result = pipe.collect()
+                    assert result == {"x": i}
+                wall = time.monotonic() - t0
+            finally:
+                pipe.close()
+            reg = monitor.registry()
+            rpc = reg.get("span_ms", name="test/exchange_rpc",
+                          worker="0")
+            col = reg.get("span_ms", name="test/exchange_collect",
+                          worker="0")
+            assert rpc.count == rounds
+            # the worker paid a fraction of the wire cost...
+            assert col.sum < 0.5 * rpc.sum, (col.sum, rpc.sum)
+            # ...and the rounds pipelined instead of serializing
+            assert wall < 0.75 * rounds * (rpc_s + compute_s), wall
+
+    def test_bounded_staleness_barrier(self):
+        pipe = _ExchangePipe(lambda p: p, "test/exchange", 0)
+        try:
+            pipe.submit(1)
+            with pytest.raises(RuntimeError, match="outstanding"):
+                pipe.submit(2)
+            payload, result = pipe.collect()
+            assert (payload, result) == (1, 1)
+            pipe.submit(3)  # collect released the barrier
+            assert pipe.collect() == (3, 3)
+        finally:
+            pipe.close()
+
+    def test_exchange_error_carried_to_worker(self):
+        """A failure inside the exchange thread (incl. an injected
+        service_call fault — same code path) re-raises at collect()
+        and poisons later submits: the supervisor sees it exactly like
+        a synchronous exchange failure."""
+
+        def boom(_):
+            raise faults.FaultInjected("injected fault at service_call")
+
+        pipe = _ExchangePipe(boom, "test/exchange", 1)
+        try:
+            pipe.submit({"g": 1})
+            with pytest.raises(faults.FaultInjected, match="injected"):
+                pipe.collect()
+            with pytest.raises(faults.FaultInjected, match="injected"):
+                pipe.submit({"g": 2})
+        finally:
+            pipe.close()
+
+    def test_close_is_idempotent_with_uncollected_result(self):
+        pipe = _ExchangePipe(lambda p: p, "test/exchange", 0)
+        pipe.submit(1)  # never collected
+        time.sleep(0.05)
+        pipe.close()
+        pipe.close()
+
+    def test_close_with_queued_request_stops_thread(self):
+        """close() racing a still-queued request must not drop the
+        STOP sentinel: the exchange thread has to exit after draining
+        the queue, not park on _req.get() forever (one leaked thread
+        per supervisor restart otherwise)."""
+        entered, release = threading.Event(), threading.Event()
+
+        def fn(p):
+            entered.set()
+            release.wait(5)
+            return p
+
+        pipe = _ExchangePipe(fn, "test/exchange", 0)
+        pipe.submit(1)
+        assert entered.wait(5)
+        # pin the race close() must survive: a request sitting in the
+        # queue (undequeued) at close time — put_nowait(_STOP) would
+        # see Full and, pre-fix, silently drop the sentinel
+        pipe._req.put_nowait(2)
+        pipe.close()
+        release.set()
+        payload, result = pipe.collect()  # frees the result slot
+        assert (payload, result) == (1, 1)
+        pipe._thread.join(timeout=5)
+        assert not pipe._thread.is_alive()
+
+
+def _overlap_witness_poller(stop: threading.Event, witnesses: list):
+    """Sample open spans; record any instant where one worker has a
+    compute span AND its exchange RPC span open SIMULTANEOUSLY —
+    impossible when the worker blocks on the wire."""
+    while not stop.is_set():
+        by_worker: dict[str, set] = {}
+        for s in monitor.open_spans():
+            w = s["labels"].get("worker")
+            if w is not None:
+                by_worker.setdefault(w, set()).add(s["name"])
+        for w, names in by_worker.items():
+            if (any("exchange_rpc" in n for n in names)
+                    and any("compute" in n for n in names)):
+                witnesses.append((w, sorted(names)))
+        time.sleep(0.002)
+
+
+def test_easgd_overlap_e2e_spans_prove_overlap(tmp_path):
+    """Overlapped EASGD on the 8-dev CPU mesh: the session still
+    exchanges and validates finite, the RPC span exists OUTSIDE any
+    compute span (nesting would produce a 'compute/.../exchange_rpc'
+    full name), and a live sampler catches compute and RPC open at the
+    same instant for the same worker.  Each exchange is slowed 50 ms
+    via the fault plane's delay action so the witness is deterministic
+    — with the worker blocking on the wire that delay would serialize,
+    with overlap it hides behind the next tau iterations."""
+    from theanompi_tpu import EASGD
+
+    faults.install([{"site": "exchange", "kind": "easgd",
+                     "action": "delay", "delay_s": 0.05, "times": -1}])
+    witnesses: list = []
+    stop = threading.Event()
+    with monitor.session(str(tmp_path / "mon")):
+        poller = threading.Thread(
+            target=_overlap_witness_poller, args=(stop, witnesses),
+            daemon=True)
+        poller.start()
+        try:
+            rule = EASGD()
+            rule.init(devices=8, modelfile="tests._tiny_models",
+                      modelclass="TinyCifar128",
+                      config=tiny_cfg(tmp_path), tau=4, alpha=0.5,
+                      checkpoint=False, overlap=True)
+            res = rule.wait()
+        finally:
+            stop.set()
+            poller.join(timeout=5)
+        assert res["n_exchanges"] > 0
+        assert np.isfinite(res["val"]["loss"])
+        snap = monitor.registry().snapshot()
+        span_names = {e["labels"]["name"] for e in snap
+                      if e["name"] == "span_ms"}
+        assert any(n.endswith("easgd/exchange_rpc") for n in span_names)
+        assert any("easgd/compute" in n for n in span_names)
+        # the acceptance criterion, structurally: no RPC span was ever
+        # nested inside a compute span (per-thread nesting would have
+        # emitted 'easgd/compute/.../exchange_rpc')
+        assert not any("compute" in n and "exchange_rpc" in n
+                       for n in span_names), span_names
+        # ...and behaviorally: compute and RPC were OPEN CONCURRENTLY
+        assert witnesses, "no instant with compute || exchange_rpc"
+
+
+def test_asgd_overlap_e2e(tmp_path):
+    """Overlapped ASGD: per-iteration push_pull pipelines against the
+    next gradient computation (staleness 1) and the session still
+    learns on synthetic cifar."""
+    from theanompi_tpu import ASGD
+
+    with monitor.session(str(tmp_path / "mon")):
+        rule = ASGD()
+        rule.init(devices=4, modelfile="tests._tiny_models",
+                  modelclass="TinyCifar128", config=tiny_cfg(tmp_path),
+                  overlap=True)
+        res = rule.wait()
+        assert res["n_updates"] > 0
+        assert np.isfinite(res["val"]["loss"])
+        snap = monitor.registry().snapshot()
+        span_names = {e["labels"]["name"] for e in snap
+                      if e["name"] == "span_ms"}
+        assert any(n.endswith("asgd/push_pull_rpc") for n in span_names)
+        assert not any("compute" in n and "push_pull_rpc" in n
+                       for n in span_names), span_names
+
+
+def test_easgd_overlap_fault_still_lands(tmp_path):
+    """Fault-site-awareness (tentpole requirement): an injected raise
+    on the exchange path fires inside the exchange THREAD, is carried
+    to the worker at collect/submit, and aborts the session with the
+    reference's fail-fast semantics — overlap must not turn injected
+    faults into silently-dropped exchanges."""
+    from theanompi_tpu import EASGD
+
+    faults.install([{"site": "exchange", "kind": "easgd",
+                     "action": "raise", "nth": 2}])
+    rule = EASGD()
+    rule.init(devices=2, modelfile="tests._tiny_models",
+              modelclass="TinyCifar128", config=tiny_cfg(tmp_path),
+              tau=4, alpha=0.5, checkpoint=False, overlap=True)
+    with pytest.raises(faults.FaultInjected):
+        rule.wait()

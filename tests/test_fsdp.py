@@ -309,6 +309,17 @@ def test_fsdp_rejects_zero_and_bf16_exchange(mesh8):
         TinyCifar128(config=ModelConfig(batch_size=4, fsdp_sharding=True,
                                         exchange_strategy="nccl16"),
                      mesh=mesh8, verbose=False)
+    # the modern spelling is rejected too: GSPMD inserts the gradient
+    # collectives itself — there is no quantization seam under FSDP
+    with pytest.raises(ValueError, match="exchange_dtype"):
+        TinyCifar128(config=ModelConfig(batch_size=4, fsdp_sharding=True,
+                                        exchange_dtype="bf16"),
+                     mesh=mesh8, verbose=False)
+    from theanompi_tpu.parallel.fsdp import make_bsp_fsdp_step
+
+    with pytest.raises(ValueError, match="no seam"):
+        make_bsp_fsdp_step(_loss, build_optimizer(0.1), mesh8, _params(),
+                           exchange_dtype="bf16")
 
 
 def test_fsdp_lars_equals_unsharded_oracle(mesh8):

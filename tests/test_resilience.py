@@ -608,16 +608,24 @@ class TestServiceResilience:
             srv = RemoteEASGD(f"127.0.0.1:{port}",
                               {"w": np.zeros(2, np.float32)}, alpha=0.5,
                               session_id="alo-test")
+            # stub BOTH read primitives: v1 pickle replies arrive via
+            # conn.recv(), v2 framed replies via conn.recv_bytes()
+            # (wire.recv_msg) — the negotiated protocol decides which
+            # one the lost-reply simulation must intercept
             real_recv = srv._conn.recv
+            real_recv_bytes = srv._conn.recv_bytes
             calls = {"n": 0}
 
-            def flaky_recv():
-                if calls["n"] == 0:
-                    calls["n"] += 1
-                    raise ConnectionResetError("reply lost")
-                return real_recv()
+            def _flaky(real):
+                def flaky(*a, **kw):
+                    if calls["n"] == 0:
+                        calls["n"] += 1
+                        raise ConnectionResetError("reply lost")
+                    return real(*a, **kw)
+                return flaky
 
-            srv._conn.recv = flaky_recv
+            srv._conn.recv = _flaky(real_recv)
+            srv._conn.recv_bytes = _flaky(real_recv_bytes)
             out = srv.exchange({"w": np.full(2, 2.0, np.float32)})
             assert np.all(np.isfinite(out["w"]))
             srv.close()
@@ -639,10 +647,13 @@ class TestServiceResilience:
             hub = RemoteGossipHub(f"127.0.0.1:{port}", 2,
                                   session_id="amo-test")
 
-            def dead_recv():
+            def dead_recv(*a, **kw):
                 raise ConnectionResetError("reply lost after send")
 
+            # kill both read primitives — see the at-least-once test
+            # above for why v1 and v2 read through different ones
             hub._conn.recv = dead_recv
+            hub._conn.recv_bytes = dead_recv
             with pytest.raises(ConnectionError, match="not\\s+re-sending"):
                 hub.push(1, {"w": np.ones(2, np.float32)}, 0.25)
             # no reconnect happened (the client raised instead of

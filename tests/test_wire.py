@@ -1,0 +1,372 @@
+"""Wire protocol v2 (parallel/wire.py): framed zero-copy pytree
+transport — round-trip fidelity, per-payload compression/dtype
+options, and the ISSUE 5 hardening bar: truncated / corrupt /
+oversized frames raise a TYPED error (never a hang, never a pickle
+call for arrays) and a drained frame leaves the connection usable.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import struct
+import zlib
+from multiprocessing import Pipe
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel import wire
+
+# importable at module scope — the namedtuple escape resolves classes
+# by module/qualname, never by pickle
+Point = collections.namedtuple("Point", ["x", "y"])
+
+
+class Exotic:
+    """Module-scope so pickle can reach it — forces the structural
+    pickle escape (arrays must never take that path)."""
+
+    def __eq__(self, other):
+        return isinstance(other, Exotic)
+
+    def __hash__(self):  # __eq__ without __hash__ would be unhashable
+        return 0
+
+
+def assert_tree_byte_equal(a, b):
+    """Exact equality incl. dtype/shape/bytes for array leaves."""
+    assert type(a) is type(b) or (
+        isinstance(a, (list, tuple)) and isinstance(b, (list, tuple))
+    ), (type(a), type(b))
+    if isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+    elif isinstance(a, dict):
+        assert list(a.keys()) == list(b.keys())
+        for k in a:
+            assert_tree_byte_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert_tree_byte_equal(x, y)
+    else:
+        assert a == b and type(a) is type(b)
+
+
+def roundtrip(msg, opts=None, decode_opts=None):
+    opts = opts or wire.WireOptions()
+    head, bufs, stats = wire.encode_frame(msg, opts)
+    # buffers cross the wire as bytes — materialize like send would
+    bufs = [b if isinstance(b, bytes) else bytes(b) for b in bufs]
+    return wire.decode_frame(head, bufs, decode_opts or opts), stats
+
+
+MIXED_TREE = {
+    "f32": np.arange(12, dtype=np.float32).reshape(3, 4) * 0.37,
+    "f64": np.linspace(0, 1, 7),
+    "f16": np.ones((2, 2), np.float16) * 0.5,
+    "i32": np.arange(-5, 5, dtype=np.int32),
+    "u8": np.arange(256, dtype=np.uint8).reshape(16, 16),
+    "bool": np.array([True, False, True]),
+    "empty": np.zeros((0, 3), np.float32),
+    "scalar0d": np.float32(3.25),
+    "nested": [1, 2.5, "three", None, True, b"raw-bytes",
+               (4, {"deep": np.full((5,), 7, np.int64)})],
+    "nt": Point(np.float32(1.5), [np.zeros(2, np.float32)]),
+}
+
+
+class TestRoundTrip:
+    def test_mixed_tree_byte_exact(self):
+        out, stats = roundtrip(MIXED_TREE)
+        assert_tree_byte_equal(out, MIXED_TREE)
+        assert stats.n_buffers == 9  # one per ndarray leaf
+        # f32/none: what hits the wire is the payload + small framing
+        assert stats.post_bytes >= sum(
+            v.nbytes for v in MIXED_TREE.values()
+            if isinstance(v, np.ndarray))
+
+    def test_non_contiguous_array(self):
+        arr = np.arange(24, dtype=np.float32).reshape(4, 6)[::2, ::3]
+        out, _ = roundtrip({"strided": arr})
+        assert_tree_byte_equal(out["strided"], np.ascontiguousarray(arr))
+
+    def test_int_float_str_subclasses_decode(self):
+        """Scalar subclasses (IntEnum config values, ...) must land on
+        the plain 'i'/'f'/'s' tags — tagging by subclass NAME would
+        produce frames the peer rejects as unknown node types."""
+        import enum
+
+        class Color(enum.IntEnum):
+            RED = 2
+
+        class Score(float):
+            pass
+
+        class Name(str):
+            pass
+
+        out, _ = roundtrip({"e": Color.RED, "f": Score(1.5),
+                            "s": Name("hi")})
+        assert out["e"] == 2 and type(out["e"]) is int
+        assert out["f"] == 1.5 and type(out["f"]) is float
+        assert out["s"] == "hi" and type(out["s"]) is str
+
+    def test_zlib_lossless_and_kept_only_when_smaller(self):
+        opts = wire.WireOptions(compression="zlib")
+        compressible = {"z": np.zeros((64, 64), np.float32)}
+        out, stats = roundtrip(compressible, opts)
+        assert_tree_byte_equal(out, compressible)
+        assert stats.post_bytes < stats.pre_bytes  # zeros compress
+        rng = np.random.default_rng(0)
+        noise = {"n": rng.standard_normal((64, 64)).astype(np.float32)}
+        out2, stats2 = roundtrip(noise, opts)
+        assert_tree_byte_equal(out2, noise)
+        # float noise doesn't shrink: the per-leaf 'none' fallback
+        # keeps the raw buffer rather than shipping a bigger one
+        assert stats2.post_bytes <= stats2.pre_bytes + 64
+
+    def test_bf16_halves_f32_and_preserves_other_dtypes(self):
+        opts = wire.WireOptions(dtype="bf16")
+        tree = {"w": np.linspace(-3, 3, 1024).astype(np.float32),
+                "step": np.arange(10, dtype=np.int32)}
+        out, stats = roundtrip(tree, opts)
+        assert out["w"].dtype == np.float32       # restored on receive
+        # bf16 keeps 8 mantissa bits: relative error <= 2^-8
+        np.testing.assert_allclose(out["w"], tree["w"], rtol=2 ** -8)
+        assert_tree_byte_equal(out["step"], tree["step"])  # untouched
+        assert stats.post_bytes < tree["w"].nbytes * 0.55 + 100
+
+    def test_optax_namedtuple_state_without_pickle(self):
+        import optax
+
+        state = optax.ScaleByAdamState(
+            count=np.zeros((), np.int32),
+            mu={"w": np.ones(3, np.float32)},
+            nu={"w": np.full(3, 2.0, np.float32)})
+        head, bufs, _ = wire.encode_frame(("ok", state),
+                                          wire.WireOptions())
+        # arrays and the optax state must NOT ride the pickle escape
+        skel = json.loads(wire.parse_header(head)[2].decode())
+        assert b"pkl" not in json.dumps(skel).encode() or \
+            '"t":"pkl"' not in json.dumps(skel, separators=(",", ":"))
+        status, out = wire.decode_frame(
+            head, [bytes(b) for b in bufs], wire.WireOptions())
+        assert status == "ok"
+        assert isinstance(out, optax.ScaleByAdamState)
+        assert_tree_byte_equal(out.mu, state.mu)
+
+    def test_arrays_never_pickled_even_with_exotic_siblings(self):
+        msg = {"arr": np.arange(4, dtype=np.float32), "obj": Exotic()}
+        head, bufs, _ = wire.encode_frame(msg, wire.WireOptions())
+        skel = wire.parse_header(head)[2].decode()
+        node = json.loads(skel)
+        by_key = dict(zip([k["v"] for k, _ in node["v"]],
+                          [v for _, v in node["v"]]))
+        assert by_key["arr"]["t"] == "nd"     # raw buffer, not pickle
+        assert by_key["obj"]["t"] == "pkl"    # only the exotic leaf
+        out = wire.decode_frame(head, [bytes(b) for b in bufs],
+                                wire.WireOptions(allow_pickle=True))
+        assert_tree_byte_equal(out["arr"], msg["arr"])
+
+    def test_allow_pickle_false_refuses_structural_escape(self):
+        head, bufs, _ = wire.encode_frame(Exotic(), wire.WireOptions())
+        with pytest.raises(wire.WireDecodeError, match="allow_pickle"):
+            wire.decode_frame(head, [bytes(b) for b in bufs],
+                              wire.WireOptions(allow_pickle=False))
+
+
+class TestDecoderHardening:
+    def _frame(self, msg=None, opts=None):
+        head, bufs, _ = wire.encode_frame(
+            msg if msg is not None else MIXED_TREE,
+            opts or wire.WireOptions())
+        return head, [bytes(b) for b in bufs]
+
+    def test_bad_magic(self):
+        head, bufs = self._frame()
+        with pytest.raises(wire.WireDecodeError, match="magic"):
+            wire.decode_frame(b"XXXX" + head[4:], bufs)
+
+    def test_bad_version(self):
+        head, bufs = self._frame()
+        with pytest.raises(wire.WireDecodeError, match="version"):
+            wire.decode_frame(head[:4] + b"\x09" + head[5:], bufs)
+
+    def test_short_header(self):
+        with pytest.raises(wire.WireDecodeError, match="header"):
+            wire.parse_header(b"TMW2\x02")
+
+    def test_truncated_skeleton(self):
+        head, bufs = self._frame()
+        with pytest.raises(wire.WireDecodeError, match="truncated"):
+            wire.decode_frame(head[:-3], bufs)
+
+    def test_oversized_buffer_count(self):
+        head, bufs = self._frame()
+        n = wire.MAX_BUFFERS + 1
+        forged = head[:6] + struct.pack(">I", n) + head[10:]
+        with pytest.raises(wire.WireDecodeError, match="buffers"):
+            wire.parse_header(forged)
+
+    def test_oversized_skeleton_declaration(self):
+        head, _ = self._frame()
+        forged = head[:10] + struct.pack(
+            ">I", wire.MAX_SKELETON_BYTES + 1) + head[14:]
+        with pytest.raises(wire.WireDecodeError, match="skeleton"):
+            wire.parse_header(forged)
+
+    def test_oversized_array_declaration(self):
+        node = {"t": "nd", "i": 0, "dtype": "float32",
+                "shape": [2 ** 40], "rawlen": wire.MAX_BUFFER_BYTES + 8,
+                "comp": "none"}
+        skel = json.dumps(node, separators=(",", ":")).encode()
+        head = struct.pack(">4sBBII", wire.MAGIC, wire.WIRE_VERSION, 0,
+                           1, len(skel)) + skel
+        with pytest.raises(wire.WireDecodeError, match="oversized"):
+            wire.decode_frame(head, [b"12345678"])
+
+    def test_corrupt_json_skeleton(self):
+        bufs = [b"\x00" * 8]
+        skel = b'{"t": "nd", CORRUPT'
+        head = struct.pack(">4sBBII", wire.MAGIC, wire.WIRE_VERSION, 0,
+                           1, len(skel)) + skel
+        with pytest.raises(wire.WireDecodeError, match="skeleton"):
+            wire.decode_frame(head, bufs)
+
+    def test_buffer_size_mismatch(self):
+        head, bufs = self._frame({"a": np.zeros(8, np.float32)})
+        with pytest.raises(wire.WireDecodeError, match="declared"):
+            wire.decode_frame(head, [bufs[0][:-4]])
+
+    def test_buffer_index_out_of_range(self):
+        head, bufs = self._frame({"a": np.zeros(8, np.float32)})
+        with pytest.raises(wire.WireDecodeError, match="buffer"):
+            wire.decode_frame(head, [])
+
+    def test_zlib_bomb_is_bounded(self):
+        # a buffer claiming rawlen=64 whose zlib stream inflates to 64MB
+        bomb = zlib.compress(b"\x00" * (64 << 20), 1)
+        node = {"t": "nd", "i": 0, "dtype": "uint8", "shape": [64],
+                "rawlen": 64, "comp": "zlib"}
+        skel = json.dumps(node, separators=(",", ":")).encode()
+        head = struct.pack(">4sBBII", wire.MAGIC, wire.WIRE_VERSION, 0,
+                           1, len(skel)) + skel
+        with pytest.raises(wire.WireDecodeError, match="declared"):
+            wire.decode_frame(head, [bomb])
+
+    def test_corrupt_zlib_buffer(self):
+        node = {"t": "nd", "i": 0, "dtype": "uint8", "shape": [64],
+                "rawlen": 64, "comp": "zlib"}
+        skel = json.dumps(node, separators=(",", ":")).encode()
+        head = struct.pack(">4sBBII", wire.MAGIC, wire.WIRE_VERSION, 0,
+                           1, len(skel)) + skel
+        with pytest.raises(wire.WireDecodeError, match="zlib"):
+            wire.decode_frame(head, [b"not zlib at all"])
+
+    def test_namedtuple_escape_refuses_arbitrary_callables(self):
+        # a forged 'nt' node must not let a peer call os.system
+        node = {"t": "nt", "mod": "os", "qual": "system", "v": []}
+        skel = json.dumps(node, separators=(",", ":")).encode()
+        head = struct.pack(">4sBBII", wire.MAGIC, wire.WIRE_VERSION, 0,
+                           0, len(skel)) + skel
+        with pytest.raises(wire.WireDecodeError, match="refusing"):
+            wire.decode_frame(head, [])
+
+    def test_unknown_node_type(self):
+        skel = json.dumps({"t": "evil"}).encode()
+        head = struct.pack(">4sBBII", wire.MAGIC, wire.WIRE_VERSION, 0,
+                           0, len(skel)) + skel
+        with pytest.raises(wire.WireDecodeError, match="unknown"):
+            wire.decode_frame(head, [])
+
+    def test_fuzz_mutations_raise_typed_errors_only(self):
+        """Seeded byte-flip fuzz over header+skeleton: every mutation
+        either decodes (flip hit a don't-care byte) or raises the
+        TYPED WireDecodeError — no hangs, no stray exception types."""
+        head, bufs = self._frame(
+            {"a": np.arange(6, dtype=np.float32),
+             "b": [1, "two", Point(3, 4)]})
+        rng = np.random.default_rng(1605)
+        for _ in range(300):
+            mutated = bytearray(head)
+            for _ in range(rng.integers(1, 4)):
+                mutated[rng.integers(0, len(mutated))] ^= int(
+                    rng.integers(1, 256))
+            try:
+                wire.decode_frame(bytes(mutated), bufs)
+            except wire.WireDecodeError:
+                pass  # the typed contract
+
+    def test_truncated_stream_times_out_not_hangs(self):
+        """A peer that dies mid-frame: recv_msg raises the typed error
+        within the buffer timeout instead of blocking forever."""
+        a, b = Pipe()
+        try:
+            head, bufs, _ = wire.encode_frame(
+                {"x": np.zeros(16, np.float32),
+                 "y": np.ones(16, np.float32)}, wire.WireOptions())
+            a.send_bytes(head)
+            a.send_bytes(bytes(bufs[0]))  # ...and never sends buffer 1
+            with pytest.raises(wire.WireDecodeError, match="truncated"):
+                wire.recv_msg(b, buf_timeout_s=0.2)
+        finally:
+            a.close()
+            b.close()
+
+    def test_connection_survives_drained_corrupt_frame(self):
+        """Valid header + all declared buffers but a corrupt skeleton:
+        the decoder drains the frame (stream stays aligned), flags
+        frame_drained, and the NEXT frame decodes normally."""
+        a, b = Pipe()
+        try:
+            # corrupt frame: well-formed header declaring 1 buffer,
+            # skeleton that parses as JSON but is semantically broken
+            skel = json.dumps({"t": "nd", "i": 0, "dtype": "float32",
+                               "shape": "NOT-A-SHAPE", "rawlen": 8,
+                               "comp": "none"}).encode()
+            head = struct.pack(">4sBBII", wire.MAGIC, wire.WIRE_VERSION,
+                               0, 1, len(skel)) + skel
+            a.send_bytes(head)
+            a.send_bytes(b"\x00" * 8)
+            with pytest.raises(wire.WireDecodeError) as ei:
+                wire.recv_msg(b, buf_timeout_s=1.0)
+            assert getattr(ei.value, "frame_drained", False) is True
+            good = {"ok": np.arange(3, dtype=np.float32)}
+            wire.send_msg(a, good, wire.WireOptions())
+            out = wire.recv_msg(b, buf_timeout_s=1.0)
+            assert_tree_byte_equal(out, good)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestNegotiation:
+    def test_accept_hello_happy_path(self):
+        opts, reply = wire.accept_hello(
+            {"version": 2, "compression": "zlib", "dtype": "bf16"})
+        assert opts.compression == "zlib" and opts.dtype == "bf16"
+        assert reply == {"version": 2, "compression": "zlib",
+                         "dtype": "bf16"}
+        # the server decodes peer frames with the pickle escape OFF:
+        # an authenticated-but-hostile client must not reach
+        # pickle.loads (the security note in docs/DESIGN.md)
+        assert opts.allow_pickle is False
+
+    def test_accept_hello_degrades_unknown_options(self):
+        opts, _ = wire.accept_hello(
+            {"version": 2, "compression": "zstd", "dtype": "fp8"})
+        assert opts.compression == "none" and opts.dtype == "f32"
+
+    def test_accept_hello_rejects_other_versions(self):
+        with pytest.raises(wire.WireProtocolError):
+            wire.accept_hello({"version": 3})
+        with pytest.raises(wire.WireProtocolError):
+            wire.accept_hello("not-a-dict")
+
+    def test_options_validate(self):
+        with pytest.raises(ValueError):
+            wire.WireOptions(compression="lz4")
+        with pytest.raises(ValueError):
+            wire.WireOptions(dtype="f16")

@@ -32,37 +32,52 @@ class AlexNetCNN(nn.Module):
 
     n_classes: int = 1000
     dtype: jnp.dtype = jnp.float32
+    #: BN variant (ModelConfig.batch_norm): each conv's bias+relu
+    #: becomes BatchNorm+relu (BN supersedes the LRN-era local
+    #: normalization but the LRN layers are kept for parity — they
+    #: are parameterless).  ``bn_axis`` threads ``_bn_axis()`` so
+    #: ``sync_bn`` is honored (ADVICE r4 wiring obligation)
+    batch_norm: bool = False
+    bn_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        def epilogue(x):
+            if not self.batch_norm:
+                return nn.relu(x)
+            return L.BatchNorm(use_running_average=not train,
+                               dtype=self.dtype,
+                               axis_name=self.bn_axis, act="relu")(x)
+
+        use_bias = not self.batch_norm
         x = x.astype(self.dtype)
         # conv1: 96 @ 11x11 /4  → LRN → pool
         x = L.Conv(96, (11, 11), strides=(4, 4), padding="VALID",
-                   kernel_init=L.gaussian_init(0.01),
+                   kernel_init=L.gaussian_init(0.01), use_bias=use_bias,
                    bias_init=L.constant_init(0.0), dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = epilogue(x)
         x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
         x = L.max_pool(x, 3, 2)
         # conv2: 256 @ 5x5, 2 groups → LRN → pool
         x = L.Conv(256, (5, 5), groups=2,
-                   kernel_init=L.gaussian_init(0.01),
+                   kernel_init=L.gaussian_init(0.01), use_bias=use_bias,
                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = epilogue(x)
         x = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)(x)
         x = L.max_pool(x, 3, 2)
         # conv3/4/5
         x = L.Conv(384, (3, 3),
-                   kernel_init=L.gaussian_init(0.01),
+                   kernel_init=L.gaussian_init(0.01), use_bias=use_bias,
                    bias_init=L.constant_init(0.0), dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = epilogue(x)
         x = L.Conv(384, (3, 3), groups=2,
-                   kernel_init=L.gaussian_init(0.01),
+                   kernel_init=L.gaussian_init(0.01), use_bias=use_bias,
                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = epilogue(x)
         x = L.Conv(256, (3, 3), groups=2,
-                   kernel_init=L.gaussian_init(0.01),
+                   kernel_init=L.gaussian_init(0.01), use_bias=use_bias,
                    bias_init=L.constant_init(0.1), dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = epilogue(x)
         x = L.max_pool(x, 3, 2)
         # fc6/fc7 with dropout, fc8 softmax head
         x = x.reshape((x.shape[0], -1))
@@ -84,6 +99,10 @@ class AlexNet(TpuModel):
     #: 2xMAC FLOPs: ~0.7 GMAC fwd @227 (one-column) x2, x ~3 fwd+bwd
     train_flops_per_sample = 4.2e9
 
+    @property
+    def uses_batchnorm(self) -> bool:  # small-shard stats warning
+        return self.config.batch_norm
+
     @classmethod
     def default_config(cls) -> ModelConfig:
         # The reference's batch-128 recipe (SURVEY.md §2.8/§5.6): SGD
@@ -104,7 +123,9 @@ class AlexNet(TpuModel):
 
     def build_module(self) -> nn.Module:
         dtype = self._compute_dtype()
-        return AlexNetCNN(n_classes=self.data.n_classes, dtype=dtype)
+        return AlexNetCNN(n_classes=self.data.n_classes, dtype=dtype,
+                          batch_norm=self.config.batch_norm,
+                          bn_axis=self._bn_axis())
 
     def build_data(self):
         # AlexNet trains on 227x227 crops (valid-padded 11x11/4 stem).

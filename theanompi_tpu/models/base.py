@@ -119,6 +119,26 @@ class ModelConfig:
     lr_scale_with_workers: str | None = None   # None | 'linear' | 'sqrt'
     exchange_strategy: str = "psum"        # reference names accepted (nccl16...)
     exchange_what: str = "grads"
+    #: ICI wire dtype of the gradient exchange: 'f32' (full precision,
+    #: default) or 'bf16' — gradients are quantized to bfloat16 for the
+    #: psum/reduce_scatter (HALF the per-step interconnect bytes on the
+    #: pod) and restored to f32 before the average and the optimizer
+    #: update, so accumulation stays f32.  The modern spelling of the
+    #: reference's nccl16/asa16 strategies; works for plain BSP and
+    #: zero_sharding (fsdp_sharding rejects it — its collectives are
+    #: compiler-inserted with no quantization seam).  Step-vs-f32
+    #: deviation is bounded by bf16's 8-bit mantissa (tolerance-pinned
+    #: in tests/test_exchanger.py)
+    exchange_dtype: str = "f32"
+    #: carry the bf16 quantization error of each shard into its next
+    #: exchange (error feedback): the residual rides
+    #: ``TrainState.exchange_residual`` (per-shard, f32, checkpointed)
+    #: and re-injects every bit the wire dropped, so the long-run
+    #: applied-gradient sum tracks the true sum to one quantization
+    #: step.  Requires exchange_dtype='bf16', exchange_what='grads',
+    #: and a pure-'data' reduce axis (the residual is per-DATA-shard
+    #: state); costs one extra f32 param-sized buffer per device
+    exchange_error_feedback: bool = False
     compute_dtype: str = "float32"         # 'bfloat16' -> MXU-friendly compute
     #: crop/flip/normalize on DEVICE (ops/augment.py) — the host ships
     #: raw uint8 and the step augments; False = host-side augmentation
@@ -164,14 +184,26 @@ class ModelConfig:
     #: reference's per-worker semantics.  Requires a shard_map step
     #: with a live 'data' axis — incompatible with fsdp_sharding
     #: (GSPMD jit has no named axes; compile_iter_fns rejects the
-    #: combination).  Honored only by models whose build_module()
-    #: threads ``_bn_axis()`` into their BN layers — today that is the
-    #: ResNet family (resnet50.py); ``layers.BatchNorm`` exposes the
-    #: same ``axis_name`` knob for new zoo models, but the builder
-    #: must pass ``self._bn_axis()`` itself (round-4 advisor).  Models
-    #: that declare ``uses_batchnorm`` warn at compile when the
-    #: per-shard batch is small and this is left False.
+    #: combination).  Honored by models whose build_module() threads
+    #: ``_bn_axis()`` into their BN layers: the ResNet family
+    #: (resnet50.py) and — with ``batch_norm=True`` — the whole
+    #: layer-toolkit zoo (VGG16/VGG19, GoogLeNet, AlexNet), which
+    #: closes the round-4 advisor's wiring obligation.  A NEW zoo
+    #: model using ``layers.BatchNorm`` must still pass
+    #: ``self._bn_axis()`` itself.  Models that declare
+    #: ``uses_batchnorm`` warn at compile when the per-shard batch is
+    #: small and this is left False.
     sync_bn: bool = False
+    #: build the BatchNorm variant of the layer-toolkit CNNs (the
+    #: classic vgg16_bn-style configuration): every conv's bias+relu
+    #: epilogue becomes ``layers.BatchNorm`` (+relu, conv bias
+    #: dropped), with ``_bn_axis()`` threaded so ``sync_bn`` is
+    #: honored — the ADVICE r4 wiring obligation now holds for the
+    #: whole zoo (VGG16/VGG19, GoogLeNet, AlexNet), not just ResNet.
+    #: The param tree changes (BatchNorm_* scale/bias + batch_stats
+    #: instead of conv bias), so flip at model build, not mid-run.
+    #: No-op for models that always carry BN (ResNet) or none (LM).
+    batch_norm: bool = False
     #: rematerialize transformer blocks in the backward pass
     #: (jax.checkpoint): activations are recomputed instead of stored,
     #: trading ~1/3 more FLOPs for O(n_layers) less activation HBM —
@@ -269,13 +301,57 @@ class TpuModel:
             params_r, ms_r, step_r = replicate(
                 (params, model_state, jnp.zeros((), jnp.int32)), self.mesh)
             return TrainState(step=step_r, params=params_r,
-                              opt_state=opt_state, model_state=ms_r)
-        return replicate(TrainState.create(params, self.tx, model_state),
-                         self.mesh)
+                              opt_state=opt_state, model_state=ms_r,
+                              exchange_residual=self._init_residual(params))
+        state = replicate(TrainState.create(params, self.tx, model_state),
+                          self.mesh)
+        return state.replace(exchange_residual=self._init_residual(params))
 
-    def _check_psum_grads_only(self, feature: str, how: str) -> None:
+    def _init_residual(self, params) -> PyTree | None:
+        """Error-feedback residual for the bf16 gradient exchange
+        (``ModelConfig.exchange_error_feedback``): zeros with a leading
+        data-shard axis, placed sharded ``P('data')`` so each shard
+        owns exactly its own quantization error
+        (parallel/bsp.py ``TrainState.exchange_residual``).  ``None``
+        (the default) leaves the state's pytree unchanged."""
+        cfg = self.config
+        if not cfg.exchange_error_feedback:
+            return None
+        if cfg.exchange_dtype != "bf16":
+            raise ValueError("exchange_error_feedback compensates bf16 "
+                             "quantization; set exchange_dtype='bf16'")
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from theanompi_tpu.parallel.mesh import AXIS_DATA
+
+        part, axes = self._batch_axes()
+        if axes != (AXIS_DATA,):
+            raise ValueError(
+                "exchange_error_feedback keeps one residual per DATA "
+                f"shard; this model reduces over {axes} — per-shard "
+                "error state is only defined for the pure-data mesh")
+        n = self.mesh.shape[AXIS_DATA]
+        if cfg.zero_sharding:
+            from theanompi_tpu.parallel.zero import (
+                init_zero_exchange_residual,
+            )
+
+            res = init_zero_exchange_residual(params, self.mesh)
+        else:
+            from theanompi_tpu.parallel.bsp import init_exchange_residual
+
+            res = init_exchange_residual(params, n)
+        sh = NamedSharding(self.mesh, P(AXIS_DATA))
+        return jax.tree.map(lambda x: jax.device_put(x, sh), res)
+
+    def _check_psum_grads_only(self, feature: str, how: str,
+                               allow_bf16_wire: bool = False) -> None:
         """Shared guard for the sharding features that ARE the gradient
-        exchange (zero/fsdp): exchange_what/strategy knobs don't apply."""
+        exchange (zero/fsdp): exchange_what/strategy knobs don't apply.
+        ``allow_bf16_wire=True`` (ZeRO) accepts the ``exchange_dtype``
+        compression knob — its reduce_scatter has a quantization seam —
+        while still rejecting the legacy strategy spelling."""
         cfg = self.config
         if cfg.exchange_what != "grads":
             raise ValueError(f"{feature} IS the gradient exchange; "
@@ -286,6 +362,12 @@ class TpuModel:
             raise ValueError(
                 f"{feature}'s {how}; the bf16-compressed strategy "
                 f"{cfg.exchange_strategy!r} does not apply")
+        if not allow_bf16_wire and (cfg.exchange_dtype != "f32"
+                                    or cfg.exchange_error_feedback):
+            raise ValueError(
+                f"{feature}'s {how}; exchange_dtype="
+                f"{cfg.exchange_dtype!r}/exchange_error_feedback do not "
+                "apply")
 
     def _check_zero_supported(self) -> None:
         from theanompi_tpu.parallel.mesh import AXIS_DATA
@@ -301,7 +383,9 @@ class TpuModel:
                              "optimizer; lars computes layerwise trust "
                              "ratios which a flat shard cannot see")
         self._check_psum_grads_only(
-            "zero_sharding", "reduce_scatter runs full-precision")
+            "zero_sharding",
+            "reduce_scatter owns the wire dtype (use exchange_dtype)",
+            allow_bf16_wire=True)
 
     def _reject_zero_sharding(self, model_kind: str) -> None:
         """Compile-time guard mirroring _reject_grad_accum for models
@@ -312,6 +396,12 @@ class TpuModel:
         if self.config.fsdp_sharding:
             raise ValueError(f"fsdp_sharding is not implemented for "
                              f"the {model_kind}")
+        if self.config.exchange_error_feedback:
+            # the residual is TrainState plumbing these custom stacks
+            # don't thread; silently ignoring new state would be worse
+            # than refusing
+            raise ValueError(f"exchange_error_feedback is not "
+                             f"implemented for the {model_kind}")
 
     def _check_fsdp_supported(self) -> None:
         from theanompi_tpu.parallel.mesh import AXIS_DATA
@@ -597,7 +687,10 @@ class TpuModel:
             self._check_zero_supported()
             zero_kw = dict(avg=(sync_type != "cdd"),
                            donate_batch=self.config.donate_batch,
-                           batch_partition=part, reduce_axes=axes)
+                           batch_partition=part, reduce_axes=axes,
+                           exchange_dtype=self.config.exchange_dtype,
+                           error_feedback=self.config
+                           .exchange_error_feedback)
             self.train_step = make_bsp_zero_step(
                 self.loss_fn, self.tx, self.mesh,
                 params_template=self.state.params,  # shapes only
@@ -621,6 +714,9 @@ class TpuModel:
             avg=(sync_type != "cdd"),
             exchange_what=self.config.exchange_what,
             axis=axes if len(axes) > 1 else axes[0],
+            exchange_dtype=(None if self.config.exchange_dtype == "f32"
+                            else self.config.exchange_dtype),
+            error_feedback=self.config.exchange_error_feedback,
         )
         self.train_step = make_bsp_train_step(self.loss_fn, self.tx,
                                               self.mesh, exchanger,

@@ -234,11 +234,14 @@ class BatchNorm(nn.Module):
     WIRING OBLIGATION (ADVICE r4): ``ModelConfig.sync_bn`` does NOT
     reach this wrapper automatically — a ``build_module()`` that uses
     it must pass ``axis_name=self._bn_axis()`` (models/base.py), or
-    ``sync_bn=True`` silently keeps per-shard stats.  Today only the
-    ResNet family threads the knob; ``TpuModel`` warns at compile when
-    a ``uses_batchnorm`` model has a small per-shard batch and
-    ``sync_bn`` off.  Regression:
-    tests/test_model_zoo.py::TestLayersBatchNormSyncWiring."""
+    ``sync_bn=True`` silently keeps per-shard stats.  The ResNet
+    family and the BN-variant toolkit zoo (``ModelConfig.batch_norm``:
+    VGG16/VGG19, GoogLeNet, AlexNet) all thread the knob — any NEW
+    zoo model using this wrapper inherits the obligation.  ``TpuModel``
+    warns at compile when a ``uses_batchnorm`` model has a small
+    per-shard batch and ``sync_bn`` off.  Regression:
+    tests/test_model_zoo.py::TestLayersBatchNormSyncWiring and
+    ::TestZooBatchNormVariants (per-model bn_axis threading)."""
 
     use_running_average: bool = False
     momentum: float = 0.9

@@ -35,13 +35,28 @@ class VGGCNN(nn.Module):
     #: param moves from Conv_*/bias to BiasAct_*/bias, so the param
     #: tree depends on this knob (see layers.BiasAct)
     act_impl: str = "xla"
+    #: vgg16_bn-style variant (ModelConfig.batch_norm): conv →
+    #: BatchNorm → relu, conv bias dropped.  ``bn_axis`` is the
+    #: cross-replica stats axis the builder threads from
+    #: ``TpuModel._bn_axis()`` so ``sync_bn`` is honored here too
+    #: (ADVICE r4 wiring obligation, layers.BatchNorm)
+    batch_norm: bool = False
+    bn_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
         for n_convs, features in self.blocks:
             for _ in range(n_convs):
-                if self.act_impl == "xla":
+                if self.batch_norm:
+                    x = L.Conv(features, (3, 3), use_bias=False,
+                               kernel_init=L.he_init(),
+                               dtype=self.dtype)(x)
+                    x = L.BatchNorm(use_running_average=not train,
+                                    dtype=self.dtype,
+                                    axis_name=self.bn_axis,
+                                    act="relu", impl=self.act_impl)(x)
+                elif self.act_impl == "xla":
                     x = L.Conv(features, (3, 3),
                                kernel_init=L.he_init(),
                                bias_init=L.constant_init(0.0),
@@ -75,6 +90,10 @@ class VGG16(TpuModel):
     train_flops_per_sample = 93.0e9
     blocks = VGG16_BLOCKS   # zoo variants (VGG19) override this
 
+    @property
+    def uses_batchnorm(self) -> bool:  # small-shard stats warning
+        return self.config.batch_norm
+
     @classmethod
     def default_config(cls) -> ModelConfig:
         return ModelConfig(
@@ -94,7 +113,9 @@ class VGG16(TpuModel):
     def build_module(self) -> nn.Module:
         return VGGCNN(blocks=self.blocks, n_classes=self.data.n_classes,
                       dtype=self._compute_dtype(),
-                      act_impl=self.config.bn_act_impl)
+                      act_impl=self.config.bn_act_impl,
+                      batch_norm=self.config.batch_norm,
+                      bn_axis=self._bn_axis())
 
     def build_data(self):
         return ImageNet_data(data_dir=self.config.data_dir, crop=224,

@@ -188,6 +188,8 @@ class Wasserstein_GAN(TpuModel):
             strategy=self.config.exchange_strategy,
             avg=(sync_type != "cdd"),
             exchange_what="grads",
+            exchange_dtype=(None if self.config.exchange_dtype == "f32"
+                            else self.config.exchange_dtype),
         )
 
         def pmean(t):

@@ -35,12 +35,24 @@ LossFn = Callable[[PyTree, PyTree, PyTree, jax.Array], tuple[jax.Array, tuple]]
 @struct.dataclass
 class TrainState:
     """Replicated training state (params + optimizer + mutable model
-    collections such as BN batch_stats)."""
+    collections such as BN batch_stats).
+
+    ``exchange_residual`` is the bf16-exchange error-feedback buffer
+    (``BSP_Exchanger.exchange_with_residual``): a per-shard f32 tree
+    carried with a LEADING data-shard axis — leaf shape
+    ``(n_data, *param_shape)`` globally, sharded ``P('data')``, seen
+    as ``(1, *param_shape)`` inside the shard body.  It is per-shard
+    state (each shard's quantization error differs), which is why it
+    cannot ride the replicated part of the tree; ``None`` (the
+    default, an empty subtree) keeps the pytree leaf set — and
+    therefore every existing checkpoint — unchanged when the feature
+    is off."""
 
     step: jax.Array
     params: PyTree
     opt_state: PyTree
     model_state: PyTree
+    exchange_residual: PyTree = None
 
     @classmethod
     def create(cls, params, tx: optax.GradientTransformation, model_state=None):
@@ -75,7 +87,8 @@ def apply_update(tx: optax.GradientTransformation, state: "TrainState",
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
     return TrainState(step=state.step + 1, params=new_params,
-                      opt_state=new_opt, model_state=new_ms)
+                      opt_state=new_opt, model_state=new_ms,
+                      exchange_residual=state.exchange_residual)
 
 
 def _default_exchanger(exchanger: BSP_Exchanger | None,
@@ -106,6 +119,28 @@ def _donate_argnums(donate: bool, donate_batch: bool) -> tuple[int, ...]:
     return (0, 1) if donate_batch else (0,)
 
 
+def state_partition_spec(residual_axis: str = AXIS_DATA) -> "TrainState":
+    """TrainState-shaped PartitionSpec tree for the shard_map step
+    builders: everything replicated EXCEPT the error-feedback residual,
+    whose leading axis is sharded over ``residual_axis``.  Each field's
+    spec is a pytree PREFIX, so this one tree covers both the
+    residual-off case (``None`` — empty subtree under the prefix) and
+    the residual-on case (every leaf split on its shard axis)."""
+    return TrainState(step=P(), params=P(), opt_state=P(),
+                      model_state=P(),
+                      exchange_residual=P(residual_axis))
+
+
+def init_exchange_residual(params: PyTree, n_shards: int) -> PyTree:
+    """Zero residual with the leading shard axis, host-side; the caller
+    places it (``P('data')`` on the leading axis)."""
+    import numpy as np
+
+    return jax.tree.map(
+        lambda p: np.zeros((n_shards,) + tuple(p.shape), np.float32),
+        params)
+
+
 def _exchange_grads_and_update(exchanger: BSP_Exchanger,
                                tx: optax.GradientTransformation,
                                state: "TrainState", grads, new_ms,
@@ -114,6 +149,19 @@ def _exchange_grads_and_update(exchanger: BSP_Exchanger,
     Used by the single/multi-step grads branch AND the accum step so
     exchange semantics live in one place."""
     new_ms = _pmean(new_ms, reduce_axes)
+    if exchanger.error_feedback:
+        if state.exchange_residual is None:
+            raise ValueError(
+                "error_feedback needs state.exchange_residual "
+                "(init_exchange_residual; models/base.py builds it from "
+                "ModelConfig.exchange_error_feedback)")
+        # residual leaves arrive per-shard as (1, *shape) — the leading
+        # axis is the data-shard axis the spec splits
+        res = jax.tree.map(lambda r: r[0], state.exchange_residual)
+        grads, new_res = exchanger.exchange_with_residual(grads, res)
+        new_state = apply_update(tx, state, grads, new_ms)
+        return new_state.replace(
+            exchange_residual=jax.tree.map(lambda r: r[None], new_res))
     grads = exchanger.exchange(grads)
     return apply_update(tx, state, grads, new_ms)
 
@@ -181,11 +229,12 @@ def make_bsp_train_step(
     decorrelation).
     """
     shard_step = _make_shard_step(loss_fn, tx, exchanger, reduce_axes)
+    st = state_partition_spec()
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(P(), batch_partition, P()),
-        out_specs=(P(), P()),
+        in_specs=(st, batch_partition, P()),
+        out_specs=(st, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
@@ -231,11 +280,12 @@ def make_bsp_multi_step(
         return state, metrics
 
     stacked_partition = P(None, *batch_partition)
+    st = state_partition_spec()
     sharded = jax.shard_map(
         shard_multi,
         mesh=mesh,
-        in_specs=(P(), stacked_partition, P()),
-        out_specs=(P(), P()),
+        in_specs=(st, stacked_partition, P()),
+        out_specs=(st, P()),
         check_vma=False,
     )
     return jax.jit(sharded,
@@ -311,11 +361,12 @@ def make_bsp_accum_step(
         return new_state, _pmean(metrics, reduce_axes)
 
     stacked_partition = P(None, *batch_partition)
+    st = state_partition_spec()
     sharded = jax.shard_map(
         shard_accum,
         mesh=mesh,
-        in_specs=(P(), stacked_partition, P()),
-        out_specs=(P(), P()),
+        in_specs=(st, stacked_partition, P()),
+        out_specs=(st, P()),
         check_vma=False,
     )
     return jax.jit(sharded,

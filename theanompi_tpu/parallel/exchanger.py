@@ -91,6 +91,21 @@ class BSP_Exchanger:
         bf16 needs no scaling, default 1.0.
       axis: mesh axis name (or tuple of names) to reduce over — a
         data x seq training step exchanges over both axes.
+      exchange_dtype: ``None`` (derive from ``strategy``) | ``'f32'`` |
+        ``'bf16'`` — the ICI wire dtype of the exchange.  ``'bf16'``
+        quantizes each leaf to bfloat16 before the psum (half the
+        gradient bytes on the pod interconnect) and restores float32
+        BEFORE the average, so the mean and the optimizer update
+        accumulate in f32.  The ``ModelConfig.exchange_dtype`` knob
+        lands here; the reference-era ``nccl16``-family strategy names
+        remain the parity spelling of the same choice.
+      error_feedback: carry the per-shard bf16 quantization error into
+        the next step's gradient (1-bit-SGD-style residual, SURVEY.md
+        compression lineage): ``exchange_with_residual`` adds the
+        stored residual before quantizing and returns the new one.
+        The residual rides ``TrainState.exchange_residual`` with a
+        leading shard axis (parallel/bsp.py threads it).  Requires the
+        bf16 wire dtype and ``exchange_what='grads'``.
     """
 
     strategy: str = "psum"
@@ -98,6 +113,8 @@ class BSP_Exchanger:
     exchange_what: str = "grads"
     fp16_scale: float = 1.0
     axis: str | tuple[str, ...] = AXIS_DATA
+    exchange_dtype: str | None = None
+    error_feedback: bool = False
 
     def __post_init__(self):
         if self.strategy not in _STRATEGY_ALIASES:
@@ -107,10 +124,32 @@ class BSP_Exchanger:
             )
         if self.exchange_what not in ("grads", "params"):
             raise ValueError("exchange_what must be 'grads' or 'params'")
+        if self.exchange_dtype not in (None, "f32", "bf16"):
+            raise ValueError(
+                f"exchange_dtype must be 'f32' or 'bf16', "
+                f"got {self.exchange_dtype!r}")
+        if self.error_feedback:
+            if self.wire_dtype != "bf16":
+                raise ValueError(
+                    "error_feedback compensates bf16 quantization; it "
+                    "needs exchange_dtype='bf16' (or a bf16 strategy)")
+            if self.exchange_what != "grads":
+                raise ValueError(
+                    "error_feedback is a gradient-compression technique; "
+                    "exchange_what='params' has no residual semantics")
 
     @property
     def resolved(self) -> str:
+        if self.exchange_dtype == "bf16":
+            return "psum_bf16"
+        if self.exchange_dtype == "f32":
+            return "psum"
         return _STRATEGY_ALIASES[self.strategy]
+
+    @property
+    def wire_dtype(self) -> str:
+        """'bf16' | 'f32' — what actually moves over ICI."""
+        return "bf16" if self.resolved == "psum_bf16" else "f32"
 
     # -- the exchange itself (must run inside shard_map over self.axis) --
 
@@ -143,21 +182,77 @@ class BSP_Exchanger:
             def reduce_leaf(x):
                 orig = x.dtype
                 y = (x * self.fp16_scale).astype(jnp.bfloat16)
-                y = jax.lax.psum(y, axis)
-                y = y.astype(orig) / self.fp16_scale
-                return y
+                y = self._bf16_sum(y, axis)
+                return (y / self.fp16_scale).astype(orig)
         else:
             def reduce_leaf(x):
                 return jax.lax.psum(x, axis)
 
         out = jax.tree.map(reduce_leaf, tree)
         if self.avg:
-            axes = (axis,) if isinstance(axis, str) else tuple(axis)
-            n = 1
-            for a in axes:
-                n *= jax.lax.axis_size(a)
+            n = self._axis_size()
             out = jax.tree.map(lambda x: x / n, out)
         return out
+
+    def _axis_size(self):
+        axes = ((self.axis,) if isinstance(self.axis, str)
+                else tuple(self.axis))
+        n = 1
+        for a in axes:
+            n *= jax.lax.axis_size(a)
+        return n
+
+    @staticmethod
+    def _bf16_sum(y, axis):
+        """Sum bf16-quantized leaves over ``axis`` with a bf16 WIRE and
+        f32 ACCUMULATION: all_gather the quantized values (bf16 on the
+        interconnect — (N-1)/N x 2 bytes/element, half a bf16 ring
+        all-reduce's traffic and a quarter of the f32 one) and reduce
+        locally in float32.
+
+        Why not ``psum(bf16)``: the psum accumulates IN bf16, and at N
+        shards the partial sums sit N x above the payload — each add
+        can then swallow an entire quantization step of the increment
+        (at N=8 a 2^-8 correction on a ~1.0 payload vanishes into the
+        ~8.0 partial sum's 2^-5 spacing).  Measured on the 8-dev CPU
+        mesh, that rounding defeats error feedback almost entirely;
+        the local f32 reduce is what makes the residual pin
+        (tests/test_exchanger.py long-run gradient-sum) hold."""
+        g = jax.lax.all_gather(y, axis)
+        return jnp.sum(g.astype(jnp.float32), axis=0)
+
+    def exchange_with_residual(self, tree: PyTree,
+                               residual: PyTree) -> tuple[PyTree, PyTree]:
+        """bf16 exchange with error feedback: quantize ``tree +
+        residual`` to bfloat16, sum the quantized values over the axis
+        with ``_bf16_sum`` (bf16 on the wire — 2 bytes/element — f32
+        accumulation locally), average in f32, and
+        return the NEW per-shard residual — the f32 difference between
+        what this shard wanted to send and what the quantizer let
+        through.  Over a run the residual re-injects every bit the
+        wire dropped, so the cumulative applied gradient tracks the
+        cumulative true gradient to within one quantization step
+        (pinned by test)."""
+        if not self.error_feedback:
+            raise ValueError("exchange_with_residual needs "
+                             "error_feedback=True")
+
+        # comp appears in both maps; XLA CSEs the duplicate add
+        q_tree = jax.tree.map(
+            lambda x, r: (x.astype(jnp.float32) + r).astype(jnp.bfloat16),
+            tree, residual)
+        new_residual = jax.tree.map(
+            lambda x, r, q: (x.astype(jnp.float32) + r)
+            - q.astype(jnp.float32),
+            tree, residual, q_tree)
+        axis = self.axis
+        out = jax.tree.map(
+            lambda q, x: self._bf16_sum(q, axis).astype(x.dtype),
+            q_tree, tree)
+        if self.avg:
+            n = self._axis_size()
+            out = jax.tree.map(lambda x: x / n, out)
+        return out, new_residual
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +287,23 @@ def easgd_both_updates(worker: PyTree, center: PyTree, alpha):
     new_w = jax.tree.map(lambda w, c: w - alpha * (w - c), worker, center)
     new_c = jax.tree.map(lambda c, w: c + alpha * (w - c), center, worker)
     return new_w, new_c
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def easgd_apply_delta(current: PyTree, snapshot: PyTree,
+                      returned: PyTree) -> PyTree:
+    """Overlapped-EASGD correction (rules/async_rules.py overlap mode).
+
+    The exchange thread shipped ``snapshot`` (the params at submit
+    time) and got back ``returned = snapshot - alpha*(snapshot -
+    center)``; meanwhile the worker trained on.  The elastic force the
+    server computed is ``delta = snapshot - returned = alpha*(snapshot
+    - center)`` — apply it to the params the worker has NOW:
+    ``current - delta``.  This is the classic staleness-1 elastic
+    update: same force, applied one exchange period late, bounded by
+    the pipe's max-1-outstanding barrier."""
+    return jax.tree.map(lambda c, s, r: c - (s - r),
+                        current, snapshot, returned)
 
 
 @partial(jax.jit, donate_argnums=(0,))

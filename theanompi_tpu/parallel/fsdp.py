@@ -124,6 +124,8 @@ def make_bsp_fsdp_step(
     multi: bool = False,
     accum: bool = False,
     specs: PyTree | None = None,
+    exchange_dtype: str = "f32",
+    error_feedback: bool = False,
 ):
     """Build the FSDP training step (plus the stacked cadences).
 
@@ -145,6 +147,20 @@ def make_bsp_fsdp_step(
     if accum and multi:
         raise ValueError("accum and multi are mutually exclusive "
                          "stacked cadences")
+    # the bf16-exchange seam (parallel/bsp.py / parallel/zero.py) does
+    # not exist here BY CONSTRUCTION: the step is plain global math and
+    # GSPMD inserts the reduce-scatters wherever the backward needs
+    # them — there is no program point between "gradient produced" and
+    # "collective issued" to quantize at.  A cast after value_and_grad
+    # would sit AFTER the compiler's collective in the dataflow and
+    # compress nothing.  Explicit parameters so the config layer's
+    # rejection has one enforced home.
+    if exchange_dtype != "f32" or error_feedback:
+        raise ValueError(
+            "fsdp_sharding's gradient collectives are compiler-inserted "
+            "at full precision; exchange_dtype='bf16'/error_feedback "
+            "have no seam here — use zero_sharding or plain BSP for "
+            "the compressed exchange")
     n = mesh.shape[AXIS_DATA]
     # one placement contract: callers that already derived specs (the
     # model layer stores them as param_specs for checkpoint-resume

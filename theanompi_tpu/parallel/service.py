@@ -15,18 +15,38 @@ mirror the stores' duck-type APIs, so a rule session is pointed at a
 remote server by a single ``server_addr=`` argument — the in-process
 store remains the fast local path.
 
-Transport: ``multiprocessing.connection`` (stdlib) — length-prefixed
-pickled messages with HMAC challenge/response auth.  Parameter pytrees
-travel as numpy trees (the reference shipped flattened GPU buffers over
-MPI; ``utils/helper_funcs.tree_to_vector`` remains available for
-byte-exact wire framing, but pickle protocol 5 already moves numpy
-buffers without copies).  The authkey gates access: the server REQUIRES
+Transport: ``multiprocessing.connection`` (stdlib) with HMAC
+challenge/response auth, speaking one of two protocols negotiated per
+connection at handshake time (docs/DESIGN.md "Wire protocol v2"):
+
+* **v2 framed** (default) — ``parallel/wire.py``: a fixed binary
+  header + JSON skeleton per message with every ndarray sent as its
+  own raw buffer via memoryview (zero-copy, never pickled), with
+  per-payload options: ``none``/``zlib`` compression and an
+  ``f32``/``bf16`` wire dtype (f32 leaves travel as bf16 and are
+  restored to f32 on receive, so accumulation at the center stores
+  stays f32).  The decoder is hardened: truncated/corrupt/oversized
+  frames raise a typed ``WireDecodeError`` — never a hang — and the
+  server drains + survives them.
+* **v1 pickle** (legacy fallback) — length-prefixed pickled tuples; a
+  client whose ``wire_hello`` is refused stays here, so old peers keep
+  working.
+
+The authkey gates access either way: the server REQUIRES
 ``THEANOMPI_TPU_SERVICE_KEY`` (auto-generating and printing a random
-one when unset), and clients refuse to connect without it — there is no
-default key, because pickle + a publicly-known secret would be remote
-code execution for anyone who can reach the port.  Even with auth, run
-the service on a trusted network: pickle is not safe against a peer
-that legitimately holds the key.
+one when unset), and clients refuse to connect without it — there is
+no default key, because the v1 fallback is pickle and a
+publicly-known secret would be remote code execution for anyone who
+can reach the port.  Even with auth, run the service on a trusted
+network: the v1 path (and the v2 structural-escape decode, see
+``wire.WireOptions.allow_pickle``) is not safe against a peer that
+legitimately holds the key; v2's ARRAY path is pickle-free in both
+directions.
+
+Client-side env knobs (all also settable per-client):
+``THEANOMPI_TPU_WIRE_PROTOCOL`` (``v2``/``v1``),
+``THEANOMPI_TPU_WIRE_COMPRESSION`` (``none``/``zlib``),
+``THEANOMPI_TPU_WIRE_DTYPE`` (``f32``/``bf16``).
 
 Launch:  ``python -m theanompi_tpu.parallel.service --port 45800``
 """
@@ -44,6 +64,7 @@ import jax
 import numpy as np
 
 from theanompi_tpu import monitor
+from theanompi_tpu.parallel import wire
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
 
@@ -301,20 +322,106 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
         # connected-client gauge: one handler thread per connection, so
         # inc/dec here IS the live connection count
         monitor.add_gauge("service/clients", 1.0)
+        # per-connection protocol state: None = v1 pickle (every
+        # connection starts there; the HMAC handshake already ran
+        # inside Listener.accept); a successful wire_hello switches
+        # BOTH directions to v2 framing for the rest of the connection
+        wire_opts: wire.WireOptions | None = None
+
+        def reply(payload, op: str = "reply"):
+            """Send a reply in the connection's current protocol.
+            True = payload sent as-is; the (truthy) string 'degraded'
+            = a serialize/encode failure was converted to an err
+            diagnostic, charged to ``op``; False = peer gone (caller
+            returns)."""
+            try:
+                if wire_opts is None:
+                    conn.send(payload)
+                else:
+                    wire.send_msg(conn, payload, wire_opts)
+                return True
+            except (EOFError, OSError):
+                return False
+            except Exception as e:
+                # reply failed to SERIALIZE/ENCODE (both transports
+                # build the full message before any byte hits the
+                # wire) — the client must still get a diagnostic, not
+                # a bare EOFError
+                monitor.inc("service/errors_total", op=op)
+                try:
+                    err = ("err", f"{type(e).__name__}: {e}")
+                    if wire_opts is None:
+                        conn.send(err)
+                    else:
+                        wire.send_msg(conn, err, wire_opts)
+                    return "degraded"
+                except Exception:
+                    return False
+
         try:
             with conn:
                 while True:
-                    try:
-                        msg = conn.recv()
-                    except (EOFError, OSError):
-                        return
+                    if wire_opts is None:
+                        try:
+                            msg = conn.recv()
+                        except (EOFError, OSError):
+                            return
+                        except Exception as e:
+                            # corrupt/unpicklable v1 request: surface a
+                            # typed diagnostic instead of silently
+                            # killing the handler thread
+                            monitor.inc("service/errors_total",
+                                        op="malformed")
+                            if not reply(("err",
+                                          f"{type(e).__name__}: {e}")):
+                                return
+                            continue
+                    else:
+                        try:
+                            msg = wire.recv_msg(conn, wire_opts)
+                        except wire.WireDecodeError as e:
+                            # typed decode failure, never a hang: the
+                            # peer gets a diagnostic; the connection
+                            # survives when the frame was drained
+                            # (stream still aligned), closes otherwise
+                            monitor.inc("service/errors_total",
+                                        op="wire_decode")
+                            ok = reply(("err",
+                                        f"{type(e).__name__}: {e}"))
+                            if not ok or not getattr(
+                                    e, "frame_drained", False):
+                                return
+                            continue
+                        except (EOFError, OSError):
+                            return
                     if not isinstance(msg, tuple) or not msg:
                         monitor.inc("service/errors_total", op="malformed")
-                        conn.send(("err", "malformed request"))
+                        if not reply(("err", "malformed request")):
+                            return
                         continue
                     op, *args = msg
+                    if op == wire.HELLO_OP:
+                        # version negotiation: confirm v2 + options on
+                        # the CURRENT protocol, then switch framing (a
+                        # legacy server would answer "unknown op" and
+                        # the client stays on v1)
+                        try:
+                            negotiated, hello_reply = wire.accept_hello(
+                                args[0] if args else None)
+                        except wire.WireProtocolError as e:
+                            if not reply(("err",
+                                          f"{type(e).__name__}: {e}")):
+                                return
+                            continue
+                        if not reply(("ok", hello_reply)):
+                            return
+                        wire_opts = negotiated
+                        monitor.inc("service/wire_negotiations_total",
+                                    compression=negotiated.compression,
+                                    dtype=negotiated.dtype)
+                        continue
                     if op == "shutdown":
-                        conn.send(("ok", None))
+                        reply(("ok", None))
                         if stop_event is not None:
                             stop_event.set()
                         # unblock accept() so the serve loop exits
@@ -330,24 +437,20 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
                         result = service.handle(op, *args)
                     except Exception as e:  # surfaced client-side
                         monitor.inc("service/errors_total", op=op)
-                        conn.send(("err", f"{type(e).__name__}: {e}"))
+                        if not reply(("err", f"{type(e).__name__}: {e}")):
+                            return
                         continue
-                    try:
-                        conn.send(("ok", result))
-                    except (EOFError, OSError):
+                    sent = reply(("ok", result), op=op)
+                    if not sent:
                         return  # peer gone; nothing to tell it
-                    except Exception as e:
-                        # reply failed to SERIALIZE (send pickles before
-                        # writing, so no bytes hit the wire yet) — the
-                        # client must still get a diagnostic, not a bare
-                        # EOFError
-                        monitor.inc("service/errors_total", op=op)
-                        conn.send(("err", f"{type(e).__name__}: {e}"))
-                        continue
-                    monitor.inc("service/requests_total", op=op)
-                    monitor.observe("service/rpc_ms",
-                                    (time.monotonic() - t0) * 1e3,
-                                    op=op)
+                    if sent is True:
+                        # a degraded (serialize-failed) reply was
+                        # already charged to errors_total under this
+                        # op — it must not also count as a success
+                        monitor.inc("service/requests_total", op=op)
+                        monitor.observe("service/rpc_ms",
+                                        (time.monotonic() - t0) * 1e3,
+                                        op=op)
                     # served work IS this process's progress
                     monitor.progress(phase="serving")
         finally:
@@ -432,15 +535,53 @@ class ServiceClient:
     when unset — there is no default key)."""
 
     def __init__(self, address: str, authkey: bytes | None = None,
-                 retry: RetryPolicy | None = None):
+                 retry: RetryPolicy | None = None,
+                 protocol: str | None = None,
+                 wire_opts: wire.WireOptions | None = None):
         host, _, port = address.rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
         self._authkey = authkey if authkey is not None else _authkey()
         self._retry = retry if retry is not None else _default_wire_retry()
+        protocol = protocol or os.environ.get(
+            "THEANOMPI_TPU_WIRE_PROTOCOL", "v2")
+        if protocol not in ("v1", "v2"):
+            raise ValueError(f"protocol must be 'v1' or 'v2', "
+                             f"got {protocol!r}")
+        self._want_v2 = protocol == "v2"
+        self._wire_opts = (wire_opts if wire_opts is not None
+                           else wire.WireOptions.from_env())
+        #: negotiated per-connection: None = v1 pickle
+        self._wire: wire.WireOptions | None = None
         self._lock = threading.Lock()
         self._conn = Client(self.address, authkey=self._authkey)
+        self._negotiate()
 
     # -- transport -----------------------------------------------------
+
+    @property
+    def wire_protocol(self) -> str:
+        """The protocol this connection actually negotiated."""
+        return "v2" if self._wire is not None else "v1"
+
+    def _negotiate(self) -> None:
+        """Version negotiation at handshake time: one v1-pickled
+        ``wire_hello`` round-trip.  A v2 server confirms and the
+        connection switches to framed mode; a legacy server answers
+        "unknown op" and the connection stays on v1 pickle — the
+        fallback is silent by design (old tmservers keep working)."""
+        self._wire = None
+        if not self._want_v2:
+            return
+        with self._lock:
+            self._conn.send((wire.HELLO_OP,
+                             wire.hello_payload(self._wire_opts)))
+            status, payload = self._conn.recv()
+        if (status == "ok" and isinstance(payload, dict)
+                and payload.get("version") == wire.WIRE_VERSION):
+            self._wire = wire.WireOptions(
+                compression=payload.get("compression", "none"),
+                dtype=payload.get("dtype", "f32"),
+                allow_pickle=self._wire_opts.allow_pickle)
 
     def _reconnect(self) -> None:
         with self._lock:
@@ -449,6 +590,8 @@ class ServiceClient:
             except OSError:
                 pass
             self._conn = Client(self.address, authkey=self._authkey)
+            # the negotiation is per-connection state — redo it
+        self._negotiate()
 
     def _rejoin(self) -> None:
         """Subclass hook: re-establish server-side session state after
@@ -463,10 +606,20 @@ class ServiceClient:
         with self._lock:
             sent = False
             try:
-                self._conn.send((op, *args))
-                sent = True
-                status, payload = self._conn.recv()
+                if self._wire is not None:
+                    wire.send_msg(self._conn, (op, *args), self._wire)
+                    sent = True
+                    status, payload = wire.recv_msg(self._conn,
+                                                    self._wire)
+                else:
+                    self._conn.send((op, *args))
+                    sent = True
+                    status, payload = self._conn.recv()
             except CONNECTION_ERRORS as e:
+                # WireDecodeError lands here too (it subclasses
+                # ConnectionError): a garbled reply stream is recovered
+                # exactly like a dropped connection — reconnect,
+                # renegotiate, re-send (at-most-once ops excepted)
                 e._tm_sent = sent
                 raise
         if status != "ok":

@@ -1,0 +1,555 @@
+"""Wire protocol v2 — framed, zero-copy, compressed pytree transport.
+
+The v1 transport (``parallel/service.py``) ships every request as one
+pickled tuple over ``multiprocessing.connection``: a 100 MB parameter
+tree is serialized by pickle (buffer copies), decoded by pickle
+(arbitrary-code execution for anyone holding the key), and there is no
+seam to compress or re-dtype the payload.  MPI-characterization work
+(arXiv:1810.11112, PAPERS.md) shows exactly this pattern — host
+serialization copies on the critical path — dominating data-parallel
+scaling before the network does.
+
+v2 splits every message into
+
+* a **fixed header** — magic ``TMW2``, flags, buffer count, skeleton
+  length — followed by a **skeleton**: the message's pytree structure
+  as JSON with each ndarray replaced by a placeholder describing its
+  buffer index, dtype, shape, wire dtype, and compression;
+* one **raw buffer per ndarray leaf**, sent straight from the array's
+  memory via ``memoryview`` — ndarrays never pass through pickle in
+  either direction.
+
+Per-payload options (negotiated at connect time, recorded per leaf so
+any frame can deviate):
+
+* ``compression``: ``'none'`` | ``'zlib'`` — zlib level 1 per buffer,
+  kept only when it actually shrinks the leaf;
+* ``dtype``: ``'f32'`` | ``'bf16'`` — float32 leaves travel as
+  bfloat16 (half the bytes; bf16 keeps f32's exponent range) and are
+  restored to float32 on receive, so *accumulation at the receiving
+  store stays f32* (``parallel/server.py`` centers never see bf16).
+
+Decoder hardening (the v1 pickle transport could neither validate nor
+survive a bad frame): every failure mode — bad magic, corrupt
+skeleton, buffer-size mismatch, zlib bomb, a peer that stops sending
+mid-frame — raises a **typed** :class:`WireDecodeError` instead of
+hanging or crashing the server loop; when the header was intact the
+decoder drains the frame's declared buffers first so the connection
+stays usable.  Structural leaves JSON cannot express (optax
+namedtuple states) are rebuilt by validated module/qualname import —
+NOT pickle — with a last-resort pickle escape that is disabled by
+default on the server side of the v2 path (see ``WireOptions``).
+
+``parallel/service.py`` negotiates v2 at HMAC-handshake time and
+falls back to v1 pickle for old peers; ``tools/bench_exchange.py``
+measures both protocols over real sockets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import os
+import struct
+import zlib
+from typing import Any
+
+import numpy as np
+
+from theanompi_tpu import monitor
+
+try:  # jax dependency; the bf16 wire dtype needs it as a numpy dtype
+    import ml_dtypes
+
+    BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    BF16 = None
+
+MAGIC = b"TMW2"
+WIRE_VERSION = 2
+#: fixed header: magic(4) version(1) flags(1) n_bufs(4) skeleton_len(4)
+_HEADER = struct.Struct(">4sBBII")
+
+#: hard ceilings so a malicious/corrupt header cannot make the decoder
+#: allocate unbounded memory (the 'oversized frame' failure mode)
+MAX_SKELETON_BYTES = 64 << 20
+MAX_BUFFERS = 1 << 16
+MAX_BUFFER_BYTES = 1 << 32
+
+#: leaves smaller than this skip zlib (the header would outweigh it)
+_MIN_COMPRESS_BYTES = 512
+
+#: how long the decoder waits for each declared buffer message before
+#: calling the frame truncated (a peer that died mid-frame must yield
+#: a typed error, never a hang)
+DEFAULT_BUF_TIMEOUT_S = float(os.environ.get(
+    "THEANOMPI_TPU_WIRE_BUF_TIMEOUT_S", "30"))
+
+_FLAG_SKELETON_ZLIB = 1
+
+
+class WireError(RuntimeError):
+    """Base class for wire-protocol failures."""
+
+
+class WireDecodeError(WireError, ConnectionError):
+    """A frame that cannot be decoded (truncated / corrupt /
+    oversized).  Subclasses ``ConnectionError`` so the service
+    client's reconnect-with-backoff loop treats a garbled *reply*
+    stream like any other transport failure (the at-most-once
+    discipline for destructive ops still applies)."""
+
+
+class WireProtocolError(WireError):
+    """Version/negotiation mismatch (not a per-frame problem)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WireOptions:
+    """Per-connection defaults for frame encoding.
+
+    ``allow_pickle`` gates the DECODE side's last-resort pickle escape
+    for exotic structural leaves; the encoder only emits that escape
+    for objects neither JSON nor the namedtuple path can express.
+    Arrays never use it in either direction.
+    """
+
+    compression: str = "none"       # 'none' | 'zlib'
+    dtype: str = "f32"              # 'f32' | 'bf16'
+    allow_pickle: bool = True
+
+    def __post_init__(self):
+        if self.compression not in ("none", "zlib"):
+            raise ValueError(
+                f"compression must be 'none' or 'zlib', "
+                f"got {self.compression!r}")
+        if self.dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"wire dtype must be 'f32' or 'bf16', got {self.dtype!r}")
+        if self.dtype == "bf16" and BF16 is None:  # pragma: no cover
+            raise RuntimeError("bf16 wire dtype needs ml_dtypes")
+
+    @classmethod
+    def from_env(cls) -> "WireOptions":
+        return cls(
+            compression=os.environ.get(
+                "THEANOMPI_TPU_WIRE_COMPRESSION", "none"),
+            dtype=os.environ.get("THEANOMPI_TPU_WIRE_DTYPE", "f32"),
+        )
+
+
+@dataclasses.dataclass
+class WireStats:
+    """Byte accounting for one frame: ``pre`` is the logical payload
+    (skeleton + every buffer at its ORIGINAL dtype), ``post`` the
+    bytes that actually hit the socket — the pre/post pair is what the
+    monitor's compression-ratio gauge is built from."""
+
+    pre_bytes: int = 0
+    post_bytes: int = 0
+    n_buffers: int = 0
+
+    @property
+    def ratio(self) -> float:
+        return self.post_bytes / self.pre_bytes if self.pre_bytes else 1.0
+
+
+# ---------------------------------------------------------------------------
+# Skeleton encoding: message structure -> JSON-able tree + buffer list
+# ---------------------------------------------------------------------------
+
+
+def _encode_node(obj: Any, bufs: list, opts: WireOptions, stats: WireStats):
+    if obj is None:
+        return {"t": "none"}
+    if isinstance(obj, bool):
+        return {"t": "bool", "v": obj}
+    # explicit tags (not type(obj).__name__): an int/float/str SUBCLASS
+    # (IntEnum, ...) must still land on a tag the peer can decode
+    if isinstance(obj, int):
+        return {"t": "i", "v": int(obj)}
+    if isinstance(obj, float):
+        return {"t": "f", "v": float(obj)}
+    if isinstance(obj, str):
+        return {"t": "s", "v": str(obj)}
+    if isinstance(obj, bytes):
+        import base64
+
+        return {"t": "by", "v": base64.b64encode(obj).decode("ascii")}
+    if isinstance(obj, np.ndarray):
+        return _encode_array(obj, bufs, opts, stats)
+    if isinstance(obj, np.generic):  # numpy scalar (np.float32(3), ...)
+        return {"t": "np0", "dtype": obj.dtype.name,
+                "v": obj.item() if obj.dtype.kind != "V" else None}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        # namedtuple (optax states): record the class by import path —
+        # rebuilt by validated import, never by pickle
+        cls = type(obj)
+        return {"t": "nt", "mod": cls.__module__,
+                "qual": cls.__qualname__,
+                "v": [_encode_node(v, bufs, opts, stats) for v in obj]}
+    if isinstance(obj, tuple):
+        return {"t": "tuple",
+                "v": [_encode_node(v, bufs, opts, stats) for v in obj]}
+    if isinstance(obj, list):
+        return {"t": "list",
+                "v": [_encode_node(v, bufs, opts, stats) for v in obj]}
+    if isinstance(obj, dict):
+        return {"t": "dict",
+                "v": [[_encode_node(k, bufs, opts, stats),
+                       _encode_node(v, bufs, opts, stats)]
+                      for k, v in obj.items()]}
+    # last resort for exotic structure (NOT arrays — handled above):
+    # a restricted pickle escape, decodable only when the peer allows
+    import base64
+    import pickle
+
+    return {"t": "pkl",
+            "v": base64.b64encode(
+                pickle.dumps(obj, protocol=2)).decode("ascii")}
+
+
+def _encode_array(arr: np.ndarray, bufs: list, opts: WireOptions,
+                  stats: WireStats) -> dict:
+    orig_dtype = arr.dtype
+    stats.pre_bytes += arr.nbytes
+    wire = arr
+    wire_dtype = orig_dtype
+    if (opts.dtype == "bf16" and orig_dtype == np.float32
+            and BF16 is not None):
+        wire = arr.astype(BF16)
+        wire_dtype = BF16
+    if not wire.flags["C_CONTIGUOUS"]:
+        wire = np.ascontiguousarray(wire)
+    if wire.nbytes == 0:
+        # memoryview cannot cast shapes with zeros; an empty leaf is
+        # an empty buffer
+        data: Any = b""
+    else:
+        try:
+            data = memoryview(wire).cast("B")
+        except (ValueError, TypeError):
+            # dtypes outside the buffer protocol (bfloat16):
+            # reinterpret as a same-width unsigned-int view — still
+            # zero-copy
+            data = memoryview(
+                wire.view(np.dtype(f"u{wire.dtype.itemsize}"))).cast("B")
+    rawlen = wire.nbytes
+    comp = "none"
+    if opts.compression == "zlib" and rawlen >= _MIN_COMPRESS_BYTES:
+        packed = zlib.compress(bytes(data), 1)
+        if len(packed) < rawlen:  # keep zlib only when it shrinks
+            data, comp = packed, "zlib"
+    node = {"t": "nd", "i": len(bufs), "dtype": orig_dtype.name,
+            "shape": list(arr.shape), "rawlen": rawlen, "comp": comp}
+    if wire_dtype is not orig_dtype:
+        node["wire"] = "bfloat16"
+    bufs.append(data)
+    stats.post_bytes += len(data) if isinstance(data, bytes) \
+        else data.nbytes
+    stats.n_buffers += 1
+    return node
+
+
+def _decode_node(node: Any, bufs: list, opts: WireOptions) -> Any:
+    try:
+        t = node["t"]
+    except (TypeError, KeyError) as e:
+        raise WireDecodeError(f"malformed skeleton node: {node!r}") from e
+    if t == "none":
+        return None
+    if t in ("bool", "i", "f", "s"):
+        return node["v"]
+    if t == "by":
+        import base64
+
+        return base64.b64decode(node["v"])
+    if t == "np0":
+        return np.dtype(node["dtype"]).type(node["v"])
+    if t == "nd":
+        return _decode_array(node, bufs)
+    if t == "tuple":
+        return tuple(_decode_node(v, bufs, opts) for v in node["v"])
+    if t == "list":
+        return [_decode_node(v, bufs, opts) for v in node["v"]]
+    if t == "dict":
+        return {_decode_node(k, bufs, opts): _decode_node(v, bufs, opts)
+                for k, v in node["v"]}
+    if t == "nt":
+        cls = _resolve_namedtuple(node["mod"], node["qual"])
+        vals = [_decode_node(v, bufs, opts) for v in node["v"]]
+        return cls(*vals)
+    if t == "pkl":
+        if not opts.allow_pickle:
+            raise WireDecodeError(
+                "frame carries a pickled structural leaf but this peer "
+                "decodes with allow_pickle=False")
+        import base64
+        import pickle
+
+        return pickle.loads(base64.b64decode(node["v"]))
+    raise WireDecodeError(f"unknown skeleton node type {t!r}")
+
+
+def _resolve_namedtuple(mod: str, qual: str):
+    """Validated import of a namedtuple class — the structural escape
+    hatch that replaces pickle for optax states.  Anything that is not
+    an importable namedtuple class is refused (no arbitrary callables,
+    no ``__reduce__`` execution)."""
+    try:
+        obj: Any = importlib.import_module(mod)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+    except Exception as e:
+        raise WireDecodeError(
+            f"cannot resolve namedtuple {mod}.{qual}: {e}") from e
+    if not (isinstance(obj, type) and issubclass(obj, tuple)
+            and hasattr(obj, "_fields")):
+        raise WireDecodeError(
+            f"{mod}.{qual} is not a namedtuple class; refusing to call it")
+    return obj
+
+
+def _decode_array(node: dict, bufs: list) -> np.ndarray:
+    try:
+        idx = int(node["i"])
+        rawlen = int(node["rawlen"])
+        shape = tuple(int(d) for d in node["shape"])
+        dtype = np.dtype(node["dtype"])
+        comp = node.get("comp", "none")
+        wire = node.get("wire")
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireDecodeError(f"malformed array node: {node!r}") from e
+    if not 0 <= idx < len(bufs):
+        raise WireDecodeError(
+            f"array node references buffer {idx} of {len(bufs)}")
+    if rawlen > MAX_BUFFER_BYTES:
+        raise WireDecodeError(
+            f"array buffer declares {rawlen} bytes "
+            f"(> {MAX_BUFFER_BYTES}); refusing oversized frame")
+    data = bufs[idx]
+    if comp == "zlib":
+        # bounded decompress: a zlib bomb cannot expand past rawlen
+        d = zlib.decompressobj()
+        try:
+            data = d.decompress(data, rawlen)
+            tail = d.decompress(d.unconsumed_tail, 1)
+        except zlib.error as e:
+            raise WireDecodeError(f"corrupt zlib buffer {idx}: {e}") from e
+        if tail or not d.eof:
+            raise WireDecodeError(
+                f"zlib buffer {idx} does not decompress to its declared "
+                f"{rawlen} bytes")
+    elif comp != "none":
+        raise WireDecodeError(f"unknown buffer compression {comp!r}")
+    if len(data) != rawlen:
+        raise WireDecodeError(
+            f"buffer {idx} is {len(data)} bytes, header declared {rawlen}")
+    wire_dtype = BF16 if wire == "bfloat16" else dtype
+    if wire_dtype is None:  # pragma: no cover
+        raise WireDecodeError("bf16 frame but ml_dtypes is unavailable")
+    try:
+        arr = np.frombuffer(data, dtype=wire_dtype).reshape(shape)
+    except ValueError as e:
+        raise WireDecodeError(
+            f"buffer {idx} does not reshape to {shape}: {e}") from e
+    if wire == "bfloat16":
+        arr = arr.astype(dtype)  # f32 restore: accumulation stays f32
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Frame assembly / parsing
+# ---------------------------------------------------------------------------
+
+
+def encode_frame(msg: Any, opts: WireOptions
+                 ) -> tuple[bytes, list, WireStats]:
+    """``msg`` (any pytree of JSON-ables + ndarrays) -> (header+skeleton
+    bytes, buffer list, stats).  Buffers are memoryviews into the
+    source arrays wherever the layout allows — the zero-copy path."""
+    stats = WireStats()
+    bufs: list = []
+    skeleton = json.dumps(
+        _encode_node(msg, bufs, opts, stats),
+        separators=(",", ":")).encode("utf-8")
+    stats.pre_bytes += len(skeleton)
+    flags = 0
+    if len(skeleton) >= _MIN_COMPRESS_BYTES and opts.compression == "zlib":
+        packed = zlib.compress(skeleton, 1)
+        if len(packed) < len(skeleton):
+            skeleton, flags = packed, _FLAG_SKELETON_ZLIB
+    if len(bufs) > MAX_BUFFERS:
+        raise WireError(f"{len(bufs)} array leaves exceed the frame "
+                        f"limit of {MAX_BUFFERS}")
+    header = _HEADER.pack(MAGIC, WIRE_VERSION, flags, len(bufs),
+                          len(skeleton))
+    stats.post_bytes += len(header) + len(skeleton)
+    return header + skeleton, bufs, stats
+
+
+def send_msg(conn, msg: Any, opts: WireOptions) -> WireStats:
+    """Send one framed message: header+skeleton, then each buffer as
+    its own length-prefixed chunk (``send_bytes`` accepts the
+    memoryview directly — no pickle, no concatenation copy)."""
+    head, bufs, stats = encode_frame(msg, opts)
+    conn.send_bytes(head)
+    for b in bufs:
+        conn.send_bytes(b)
+    if monitor.enabled():
+        monitor.inc("service/wire_bytes_pre", stats.pre_bytes, dir="send")
+        monitor.inc("service/wire_bytes_post", stats.post_bytes, dir="send")
+        monitor.set_gauge("service/wire_compression_ratio", stats.ratio,
+                          dir="send")
+    return stats
+
+
+def parse_header(head: bytes) -> tuple[int, int, bytes]:
+    """(flags, n_bufs, skeleton_bytes) from a header+skeleton chunk;
+    raises :class:`WireDecodeError` on anything malformed."""
+    if len(head) < _HEADER.size:
+        raise WireDecodeError(
+            f"frame header is {len(head)} bytes, need {_HEADER.size}")
+    magic, version, flags, n_bufs, skel_len = _HEADER.unpack_from(head)
+    if magic != MAGIC:
+        raise WireDecodeError(f"bad frame magic {magic!r}")
+    if version != WIRE_VERSION:
+        raise WireDecodeError(f"unsupported wire version {version}")
+    if n_bufs > MAX_BUFFERS:
+        raise WireDecodeError(f"frame declares {n_bufs} buffers "
+                              f"(> {MAX_BUFFERS})")
+    if skel_len > MAX_SKELETON_BYTES:
+        raise WireDecodeError(f"frame declares a {skel_len}-byte skeleton "
+                              f"(> {MAX_SKELETON_BYTES})")
+    skeleton = head[_HEADER.size:]
+    if len(skeleton) != skel_len:
+        raise WireDecodeError(
+            f"skeleton is {len(skeleton)} bytes, header declared "
+            f"{skel_len} (truncated frame)")
+    return flags, n_bufs, skeleton
+
+
+def decode_frame(head: bytes, bufs: list,
+                 opts: WireOptions | None = None) -> Any:
+    """Rebuild the message from a header+skeleton chunk and its
+    buffers.  All failures raise :class:`WireDecodeError`."""
+    opts = opts or WireOptions()
+    flags, n_bufs, skeleton = parse_header(head)
+    if n_bufs != len(bufs):
+        raise WireDecodeError(
+            f"frame declared {n_bufs} buffers, got {len(bufs)}")
+    if flags & _FLAG_SKELETON_ZLIB:
+        d = zlib.decompressobj()
+        try:
+            skeleton = d.decompress(skeleton, MAX_SKELETON_BYTES)
+        except zlib.error as e:
+            raise WireDecodeError(f"corrupt skeleton zlib: {e}") from e
+        if not d.eof:
+            raise WireDecodeError("skeleton exceeds the size ceiling")
+    try:
+        tree = json.loads(skeleton.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireDecodeError(f"corrupt frame skeleton: {e}") from e
+    return _decode_node(tree, bufs, opts)
+
+
+def recv_msg(conn, opts: WireOptions | None = None,
+             buf_timeout_s: float | None = None,
+             first_chunk: bytes | None = None) -> Any:
+    """Receive one framed message.
+
+    ``first_chunk`` lets a caller that already pulled the first chunk
+    off the connection (the server's negotiation loop) hand it in.
+    After a valid header, each declared buffer must arrive within
+    ``buf_timeout_s`` — a peer that stops mid-frame produces a typed
+    :class:`WireDecodeError`, never a hang.  When the header was
+    parseable, the declared buffers are drained even if the skeleton
+    later proves corrupt, so the connection stays frame-aligned and
+    usable ('the connection survives').
+    """
+    timeout = DEFAULT_BUF_TIMEOUT_S if buf_timeout_s is None \
+        else buf_timeout_s
+    # the ceilings must bind at READ time, not after the allocation:
+    # recv_bytes(maxlength) makes a chunk whose own length prefix
+    # declares more raise OSError before the body is ever buffered
+    head = conn.recv_bytes(_HEADER.size + MAX_SKELETON_BYTES) \
+        if first_chunk is None else first_chunk
+    # an unparseable header raises with frame_drained=False: the peer's
+    # buffer chunks (if any) are unidentifiable, so the stream cannot
+    # be resynchronized — the caller should close this connection
+    flags, n_bufs, _ = parse_header(head)
+    bufs: list = []
+    pre = post = 0
+    for i in range(n_bufs):
+        if not conn.poll(timeout):
+            raise WireDecodeError(
+                f"truncated frame: buffer {i}/{n_bufs} never arrived "
+                f"within {timeout}s")
+        bufs.append(conn.recv_bytes(MAX_BUFFER_BYTES))
+        post += len(bufs[-1])
+    try:
+        msg = decode_frame(head, bufs, opts)
+    except WireDecodeError as e:
+        # header was valid and every declared buffer was consumed, so
+        # the stream is still frame-aligned — the connection survives
+        e.frame_drained = True
+        raise
+    if monitor.enabled():
+        for a in _iter_arrays(msg):
+            pre += a.nbytes
+        pre += len(head)
+        post += len(head)
+        monitor.inc("service/wire_bytes_pre", pre, dir="recv")
+        monitor.inc("service/wire_bytes_post", post, dir="recv")
+    return msg
+
+
+def _iter_arrays(obj: Any):
+    if isinstance(obj, np.ndarray):
+        yield obj
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            yield from _iter_arrays(v)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            yield from _iter_arrays(v)
+
+
+# ---------------------------------------------------------------------------
+# Negotiation (rides the v1 pickle channel once per connection)
+# ---------------------------------------------------------------------------
+
+#: the op a v2-capable client sends as its FIRST request; a v2 server
+#: answers ("ok", {"version": 2, ...}) and switches the connection to
+#: framed mode, a legacy server answers ("err", "unknown op ...") and
+#: the client stays on v1 pickle.
+HELLO_OP = "wire_hello"
+
+
+def hello_payload(opts: WireOptions) -> dict:
+    return {"version": WIRE_VERSION, "compression": opts.compression,
+            "dtype": opts.dtype}
+
+
+def accept_hello(payload: Any) -> tuple[WireOptions, dict]:
+    """Server side: validate a hello payload, returning the negotiated
+    options and the reply dict.  Unknown/newer options degrade to the
+    safe defaults rather than failing the connection."""
+    if not isinstance(payload, dict):
+        raise WireProtocolError(f"malformed wire_hello: {payload!r}")
+    version = payload.get("version")
+    if version != WIRE_VERSION:
+        raise WireProtocolError(
+            f"peer requested wire version {version!r}; this server "
+            f"speaks {WIRE_VERSION} (v1 pickle needs no hello)")
+    comp = payload.get("compression", "none")
+    dtype = payload.get("dtype", "f32")
+    if comp not in ("none", "zlib"):
+        comp = "none"
+    if dtype not in ("f32", "bf16"):
+        dtype = "f32"
+    # the pickle escape stays OFF for frames the server decodes: an
+    # authenticated-but-hostile peer must not reach pickle.loads
+    opts = WireOptions(compression=comp, dtype=dtype, allow_pickle=False)
+    return opts, hello_payload(opts)

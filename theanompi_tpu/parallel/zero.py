@@ -47,6 +47,7 @@ from theanompi_tpu.parallel.bsp import (
     accumulate_microbatch_grads,
     grad_and_metrics,
 )
+from theanompi_tpu.parallel.bsp import state_partition_spec  # noqa: F401
 from theanompi_tpu.parallel.mesh import AXIS_DATA
 
 PyTree = Any
@@ -111,6 +112,17 @@ def init_zero_opt_state(tx: optax.GradientTransformation, params: PyTree,
     return jax.jit(sharded)(params), specs
 
 
+def init_zero_exchange_residual(params_template: PyTree,
+                                mesh: jax.sharding.Mesh) -> np.ndarray:
+    """Zero error-feedback residual for the ZeRO step: the padded flat
+    gradient vector per data shard, host-side ``(n_data, total+pad)``
+    f32 — the caller places it sharded ``P('data')`` on the leading
+    axis (models/base.py ``_create_state``)."""
+    n = mesh.shape[AXIS_DATA]
+    total, pad, _ = _flat_info(params_template, n)
+    return np.zeros((n, total + pad), np.float32)
+
+
 def make_bsp_zero_step(
     loss_fn,
     tx: optax.GradientTransformation,
@@ -123,8 +135,23 @@ def make_bsp_zero_step(
     reduce_axes: tuple[str, ...] = (AXIS_DATA,),
     accum: bool = False,
     multi: bool = False,
+    exchange_dtype: str = "f32",
+    error_feedback: bool = False,
 ):
     """Build the ZeRO-1 training step.
+
+    ``exchange_dtype='bf16'`` quantizes the flat gradient vector to
+    bfloat16 before the data-axis ``psum_scatter`` — the ring
+    reduce-scatter (and therefore the pod's ICI gradient bytes) moves
+    2 bytes/element — and upcasts the received shard to f32 BEFORE the
+    extra-axis psum, the average, and the optimizer update, so
+    accumulation on the shard stays f32.  ``error_feedback=True``
+    additionally carries each shard's f32 quantization error in
+    ``state.exchange_residual`` (flat, ``(n_data, total+pad)`` global,
+    sharded over 'data') and re-injects it into the next exchange —
+    the cumulative applied gradient then tracks the cumulative true
+    gradient to one quantization step (same scheme as the unflattened
+    path in parallel/bsp.py).
 
     ``accum=True`` builds the grad-accumulation variant instead:
     ``step(state, stacked_batch, rng)`` with a leading microbatch axis
@@ -152,13 +179,20 @@ def make_bsp_zero_step(
     if accum and multi:
         raise ValueError("accum and multi are mutually exclusive "
                          "stacked cadences")
+    if exchange_dtype not in ("f32", "bf16"):
+        raise ValueError(f"exchange_dtype must be 'f32' or 'bf16', "
+                         f"got {exchange_dtype!r}")
+    if error_feedback and exchange_dtype != "bf16":
+        raise ValueError("error_feedback compensates bf16 quantization; "
+                         "it needs exchange_dtype='bf16'")
     extra_axes = tuple(a for a in reduce_axes if a != AXIS_DATA)
     n = mesh.shape[AXIS_DATA]
     n_total = n * int(np.prod([mesh.shape[a] for a in extra_axes] or [1]))
     total, pad, per_shard = _flat_info(params_template, n)
     _, opt_specs = _opt_specs(tx, per_shard)
     state_in_specs = TrainState(step=P(), params=P(), opt_state=opt_specs,
-                                model_state=P())
+                                model_state=P(),
+                                exchange_residual=P(AXIS_DATA))
 
     def exchange_and_update(state, gflat, new_ms):
         """The ZeRO tail, from a local padded fp32 flat gradient:
@@ -166,8 +200,28 @@ def make_bsp_zero_step(
         1/N shard over the extra axes moves data-axis-size times less
         traffic than psum-ing the full vector would), update the
         shard, all_gather the params."""
-        gshard = lax.psum_scatter(gflat, AXIS_DATA, scatter_dimension=0,
+        new_res = state.exchange_residual
+        if exchange_dtype == "bf16":
+            # quantize before the scatter (2 bytes/element on the
+            # wire), accumulate in f32: a bf16 psum_scatter would
+            # round every partial sum to 8 mantissa bits and (at N
+            # shards) swallow quantization-step-sized corrections —
+            # the same failure the exchanger's _bf16_sum documents.
+            # all_to_all moves exactly the ring reduce-scatter's
+            # (N-1)/N x bytes, but every add happens locally in f32.
+            if error_feedback:
+                comp = gflat + state.exchange_residual[0]
+                q = comp.astype(jnp.bfloat16)
+                new_res = (comp - q.astype(jnp.float32))[None]
+            else:
+                q = gflat.astype(jnp.bfloat16)
+            recv = lax.all_to_all(q.reshape(n, -1), AXIS_DATA,
+                                  split_axis=0, concat_axis=0,
                                   tiled=True)
+            gshard = jnp.sum(recv.astype(jnp.float32), axis=0)
+        else:
+            gshard = lax.psum_scatter(gflat, AXIS_DATA,
+                                      scatter_dimension=0, tiled=True)
         if extra_axes:
             gshard = lax.psum(gshard, extra_axes)
         if avg:
@@ -184,7 +238,8 @@ def make_bsp_zero_step(
         new_pflat = lax.all_gather(new_pshard, AXIS_DATA, tiled=True)
         new_params = unravel(new_pflat[:total].astype(pdtype))
         return TrainState(step=state.step + 1, params=new_params,
-                          opt_state=new_opt, model_state=new_ms)
+                          opt_state=new_opt, model_state=new_ms,
+                          exchange_residual=new_res)
 
     def shard_step(state: TrainState, batch, rng):
         rng = _fold_axis_rng(rng, reduce_axes)

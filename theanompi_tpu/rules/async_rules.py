@@ -42,9 +42,15 @@ from typing import Any
 import jax
 import numpy as np
 
+import queue
+
 from theanompi_tpu import monitor
 from theanompi_tpu.models.base import TpuModel
-from theanompi_tpu.parallel.exchanger import gosgd_merge, gosgd_scale_momentum
+from theanompi_tpu.parallel.exchanger import (
+    easgd_apply_delta,
+    gosgd_merge,
+    gosgd_scale_momentum,
+)
 from theanompi_tpu.parallel.mesh import data_mesh, replicate
 from theanompi_tpu.parallel.server import ASGDServer, EASGDServer, GossipHub
 from theanompi_tpu.parallel.service import (
@@ -78,6 +84,104 @@ def _prune_gosgd_sidecars(sidecar_dir: str, kept: set[int]) -> None:
                 os.unlink(path)
             except OSError:
                 pass
+
+
+#: _ExchangePipe shutdown sentinel
+_STOP = object()
+
+
+class _ExchangePipe:
+    """One in-flight parameter exchange per worker — the comm/compute
+    overlap plane (ISSUE 5 tentpole; the reference hid its MPI
+    exchanges behind compute the same way, with a dedicated exchanger
+    stream per worker).
+
+    ``submit(payload)`` hands a HOST-side payload to this worker's
+    exchange thread and returns immediately; the worker keeps
+    computing while the RPC (serialize + wire + server merge) runs.
+    ``collect()`` blocks until the in-flight exchange finishes and
+    returns ``(payload, result)``.  The barrier is bounded-staleness:
+    at most ONE exchange outstanding (``submit`` while outstanding
+    raises), so a worker can never run ahead of the center by more
+    than one exchange period.
+
+    Fault-site-aware: the exchange function runs the SAME client call
+    path as the synchronous mode, so an injected ``service_call``
+    fault (resilience.faults) still lands — its exception is carried
+    to the worker and re-raised at ``collect()``/``submit()``, where
+    the supervisor's restart semantics see it exactly like a
+    synchronous failure.
+
+    Telemetry: each RPC runs under a top-level ``<name>_rpc`` span in
+    the exchange thread; the worker's wait inside ``collect`` is its
+    own ``<name>_collect`` span — the monitor can therefore PROVE
+    overlap (compute spans no longer enclose the RPC span; collect
+    time << rpc time), asserted by tests/test_async_overlap.py."""
+
+    def __init__(self, fn, name: str, worker: int):
+        self._fn = fn
+        self._name = name
+        self._worker = str(worker)
+        self._req: queue.Queue = queue.Queue(maxsize=1)
+        self._res: queue.Queue = queue.Queue(maxsize=1)
+        self._err: BaseException | None = None
+        self.outstanding = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"{name}-exchange-w{worker}")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._req.get()
+            if item is _STOP:
+                return
+            try:
+                with monitor.span(f"{self._name}_rpc",
+                                  worker=self._worker):
+                    out = (self._fn(item), None)
+            except BaseException as e:  # surfaced at collect()
+                out = (None, e)
+            self._res.put((item, out))
+
+    def submit(self, payload) -> None:
+        """Hand one host payload to the exchange thread (returns
+        immediately).  A prior failure or an already-outstanding
+        exchange raises here."""
+        if self._err is not None:
+            raise self._err
+        if self.outstanding:
+            raise RuntimeError(
+                f"{self._name}: bounded-staleness barrier — at most one "
+                "exchange may be outstanding; collect() first")
+        self._req.put(payload)
+        self.outstanding = True
+
+    def collect(self):
+        """Block for the in-flight exchange; returns (payload, result).
+        Re-raises the exchange thread's exception (incl. injected
+        faults) in the worker thread."""
+        payload, (result, err) = self._res.get()
+        self.outstanding = False
+        if err is not None:
+            self._err = err
+            raise err
+        return payload, result
+
+    def close(self) -> None:
+        """Stop the exchange thread (idempotent; never blocks on an
+        uncollected result — the queues hold at most one item each)."""
+        try:
+            self._req.put_nowait(_STOP)
+        except queue.Full:
+            # a request is still queued: a dropped sentinel would leave
+            # the exchange thread parked on _req.get() forever (pinning
+            # the client + model closures across supervisor restarts) —
+            # a reaper delivers STOP once the thread dequeues the
+            # request, without blocking the worker here
+            threading.Thread(target=self._req.put, args=(_STOP,),
+                             daemon=True,
+                             name=f"{self._name}-exchange-reaper").start()
 
 
 class _AsyncRule(Rule):
@@ -153,6 +257,7 @@ class EASGD(_AsyncRule):
                  max_epochs: int | None = None, checkpoint: bool = True,
                  server_addr: str | None = None,
                  session_id: str | None = None,
+                 overlap: bool = False,
                  max_restarts: int = 0, min_workers: int = 1, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
@@ -235,6 +340,31 @@ class EASGD(_AsyncRule):
 
             def work(abort: threading.Event):
                 srv = connect()
+                # overlap mode: this worker's exchange thread — RPCs
+                # run there while the worker computes the next tau
+                # iterations; bounded staleness 1 (docs/DESIGN.md
+                # "Overlapped exchange")
+                # the fetch-to-host of the result ALSO happens in the
+                # exchange thread (an in-process store returns device
+                # arrays committed to the server's jit device; fetching
+                # them at collect() would re-serialize the worker on
+                # exactly the latency overlap exists to hide)
+                pipe = _ExchangePipe(
+                    lambda p: jax.tree.map(
+                        np.asarray, jax.device_get(srv.exchange(p))),
+                    "easgd/exchange", rank) if overlap else None
+
+                def collect_and_correct():
+                    """Apply the finished exchange's elastic force to
+                    the params the worker has NOW (easgd_apply_delta:
+                    same force, one period late)."""
+                    with monitor.span("easgd/exchange_collect",
+                                      worker=str(rank)):
+                        snap, returned = pipe.collect()
+                    model.state = model.state.replace(
+                        params=easgd_apply_delta(model.state.params,
+                                                 snap, returned))
+
                 try:
                     model.compile_iter_fns("avg")
                     it_total = 0
@@ -249,14 +379,27 @@ class EASGD(_AsyncRule):
                             t_it = time.monotonic()
                             if it_total % tau == 0:
                                 recorder.start()
-                                with monitor.span("easgd/exchange",
-                                                  worker=str(rank)):
-                                    new_params = srv.exchange(
-                                        model.state.params)
-                                model.state = model.state.replace(
-                                    params=new_params)
+                                if pipe is None:
+                                    with monitor.span("easgd/exchange",
+                                                      worker=str(rank)):
+                                        new_params = srv.exchange(
+                                            model.state.params)
+                                    model.state = model.state.replace(
+                                        params=new_params)
+                                else:
+                                    if pipe.outstanding:
+                                        collect_and_correct()
+                                    # host snapshot BEFORE the next
+                                    # train dispatch can donate these
+                                    # buffers; the RPC overlaps the
+                                    # next tau iterations
+                                    pipe.submit(jax.tree.map(
+                                        np.asarray, jax.device_get(
+                                            model.state.params)))
                                 recorder.end("comm")
-                            model.train_iter(it, recorder)
+                            with monitor.span("easgd/compute",
+                                              worker=str(rank)):
+                                model.train_iter(it, recorder)
                             it_total += 1
                             # feeds the step histogram, heartbeat, and
                             # the cross-worker straggler detector —
@@ -270,10 +413,14 @@ class EASGD(_AsyncRule):
                         model.adjust_hyperp(epoch + 1)
                         if rank == 0:
                             epoch_done.release()
+                    if pipe is not None and pipe.outstanding:
+                        collect_and_correct()  # drain the last one
                     # final elastic sync so worker state ~ center
                     model.state = model.state.replace(
                         params=srv.exchange(model.state.params))
                 finally:
+                    if pipe is not None:
+                        pipe.close()
                     model.cleanup()
                     if srv is not server and isinstance(srv, ServiceClient):
                         srv.close()
@@ -348,6 +495,7 @@ class ASGD(_AsyncRule):
                  sync_type, max_epochs: int | None = None,
                  checkpoint: bool = True, server_addr: str | None = None,
                  session_id: str | None = None,
+                 overlap: bool = False,
                  max_restarts: int = 0, min_workers: int = 1, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
@@ -431,6 +579,15 @@ class ASGD(_AsyncRule):
 
             def work(abort: threading.Event):
                 srv = connect()
+                # overlap mode: the push_pull RPC for iteration i runs
+                # in the exchange thread while this worker computes
+                # iteration i+1's gradients on its current (one-push-
+                # stale) params — classic async-SGD pipelining with the
+                # staleness bounded at 1 by the pipe's barrier
+                pipe = _ExchangePipe(
+                    lambda g: jax.tree.map(
+                        np.asarray, jax.device_get(srv.push_pull(g))),
+                    "asgd/push_pull", rank) if overlap else None
                 try:
                     gstep = model.compile_grad_fn()
                     it_total = 0
@@ -448,16 +605,37 @@ class ASGD(_AsyncRule):
                             batch = next(model._train_iter)
                             recorder.end("wait")
                             recorder.start()
-                            grads, new_ms, metrics = gstep(
-                                model.state, batch, model._next_rng())
+                            with monitor.span("asgd/compute",
+                                              worker=str(rank)):
+                                grads, new_ms, metrics = gstep(
+                                    model.state, batch, model._next_rng())
                             recorder.end("calc", block_on=metrics)
                             recorder.start()
-                            with monitor.span("asgd/push_pull",
-                                              worker=str(rank)):
-                                fresh = srv.push_pull(grads)
-                            model.state = model.state.replace(
-                                params=replicate(fresh, model.mesh),
-                                model_state=new_ms)
+                            if pipe is None:
+                                with monitor.span("asgd/push_pull",
+                                                  worker=str(rank)):
+                                    fresh = srv.push_pull(grads)
+                                model.state = model.state.replace(
+                                    params=replicate(fresh, model.mesh),
+                                    model_state=new_ms)
+                            else:
+                                # collect the PREVIOUS push's fresh
+                                # center (it overlapped this step's
+                                # compute), then hand off this step's
+                                # grads
+                                new_params = model.state.params
+                                if pipe.outstanding:
+                                    with monitor.span(
+                                            "asgd/push_pull_collect",
+                                            worker=str(rank)):
+                                        _, fresh = pipe.collect()
+                                    new_params = replicate(fresh,
+                                                           model.mesh)
+                                pipe.submit(jax.tree.map(
+                                    np.asarray, jax.device_get(grads)))
+                                model.state = model.state.replace(
+                                    params=new_params,
+                                    model_state=new_ms)
                             recorder.end("comm")
                             recorder.train_metrics(float(metrics["loss"]),
                                                    float(metrics["error"]),
@@ -497,7 +675,15 @@ class ASGD(_AsyncRule):
                                     ),
                                     "epoch": epoch,
                                 })
+                    if pipe is not None and pipe.outstanding:
+                        # drain: the last grads must reach the center
+                        # before the session's final validation
+                        _, fresh = pipe.collect()
+                        model.state = model.state.replace(
+                            params=replicate(fresh, model.mesh))
                 finally:
+                    if pipe is not None:
+                        pipe.close()
                     model.cleanup()
                     if srv is not server and isinstance(srv, ServiceClient):
                         srv.close()

@@ -435,6 +435,13 @@ def serve_main(export_dir: str, host: str = "0.0.0.0",
                buckets: tuple[int, ...] | None = None,
                max_queue: int = 32, max_restarts: int = 2,
                reload_poll_s: float = 1.0) -> int:
+    # persistent compilation cache before any replica warms up: the
+    # per-bucket eval programs compile once per (shape, flags) EVER,
+    # not once per server restart — a hot-standby restart re-serves in
+    # deserialization time (no flag/env -> no-op)
+    from theanompi_tpu.utils.helper_funcs import enable_compilation_cache
+
+    enable_compilation_cache()
     policy = BatchPolicy(max_batch=max_batch, max_delay_ms=max_delay_ms,
                          buckets=buckets, max_queue=max_queue)
     # serving telemetry mirrors the param service's: request-driven
@@ -476,11 +483,22 @@ def main(argv=None) -> int:
     ap.add_argument("--reload-poll-s", type=float, default=1.0)
     ap.add_argument("--platform", default=None,
                     help="jax platform (e.g. 'cpu')")
+    ap.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache: warmup "
+                         "deserializes the per-bucket eval programs "
+                         "instead of recompiling on every server "
+                         "restart (also honors "
+                         "THEANOMPI_TPU_COMPILATION_CACHE)")
     args = ap.parse_args(argv)
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.compilation_cache_dir:
+        import os
+
+        os.environ["THEANOMPI_TPU_COMPILATION_CACHE"] = \
+            args.compilation_cache_dir
     buckets = (tuple(int(b) for b in args.buckets.split(","))
                if args.buckets else None)
     return serve_main(args.export_dir, args.host, args.port,

@@ -21,6 +21,45 @@ import optax
 PyTree = Any
 
 
+#: env channel for the persistent XLA compilation cache — set by the
+#: launchers'/tools' ``--compilation-cache-dir`` flags so every
+#: subprocess a run spawns shares one cache
+COMPILATION_CACHE_ENV = "THEANOMPI_TPU_COMPILATION_CACHE"
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Turn on JAX's persistent compilation cache under ``cache_dir``
+    (or ``$THEANOMPI_TPU_COMPILATION_CACHE``; no-op when neither is
+    set, returning None).
+
+    Why: the measured ResNet-50 step compile is 39.3 s on the tunnel
+    (BASELINE.md) — a third of a 10-minute TPU window.  With the cache
+    on, a repeat window deserializes the executable instead of
+    recompiling, so the queue's ladder and the serving warmup pay the
+    compile once per (program, flags) pair, not once per process.  The
+    cache key includes the XLA flags and jax version, so flag sweeps
+    (tools/xla_sweep.py) never cross-contaminate.
+
+    Exports the env var so subprocesses (run_tpu_queue children, the
+    bench probe, spawned services) inherit the same cache directory.
+    """
+    cache_dir = cache_dir or os.environ.get(COMPILATION_CACHE_ENV)
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        # cache every compile the moment it costs anything: the default
+        # min-compile-time gate (1 s) is fine, but tiny-entry skipping
+        # would drop the many small jitted helpers the rules dispatch
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except AttributeError:  # older jax without the knob
+        pass
+    os.environ[COMPILATION_CACHE_ENV] = cache_dir
+    return cache_dir
+
+
 def divide_batches(n_samples: int, batch_size: int, drop_remainder: bool = True) -> int:
     """Number of batches per epoch (reference dropped ragged tails)."""
     if drop_remainder:

@@ -187,7 +187,21 @@ PYEOF
   SERVING_RC=$?
   rm -rf "$SERVEDIR"
   echo "serving smoke rc=$SERVING_RC"
-  if [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ]; then
+  echo "## exchange-bench smoke (wire v1 vs v2 over real sockets, docs/DESIGN.md 'Wire protocol v2')"
+  # the comms vertical end-to-end: drive the ~25M-param ResNet-50-sized
+  # tree through the param service in every protocol x compression x
+  # dtype mode; the gate asserts v2-framed beats v1-pickle on
+  # bytes/exchange (lossless zlib/f32 AND the >=45% bf16 headline cut)
+  # and that the wire compression-ratio gauge landed in the monitor
+  # JSONL (tools/bench_exchange.py --smoke, exit 1 on any miss)
+  EXCHDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$EXCHDIR" \
+    python tools/bench_exchange.py --smoke \
+      --out "$EXCHDIR/BENCH_wire_smoke.json"
+  EXCHANGE_RC=$?
+  rm -rf "$EXCHDIR"
+  echo "exchange smoke rc=$EXCHANGE_RC"
+  if [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
     exit 1
