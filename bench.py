@@ -360,10 +360,22 @@ def _main() -> int:
     _install_kill_handler()
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        platform, err = "cpu", ""  # no tunnel involved; probe is moot
+    else:
+        _set_phase("probe")
+        with monitor.span("bench/probe"):
+            platform, err = _probe_backend()
+    if platform is None:
+        print(_failure_json(f"no measurement taken — {err}"), flush=True)
+        return 1
     # persistent compilation cache: a repeat tunnel window skips the
     # measured 39.3 s ResNet-50 compile.  Opt-out by exporting an empty
     # THEANOMPI_TPU_COMPILATION_CACHE; default under artifacts/ so the
-    # queue's windows share it
+    # queue's windows share it.  Imported AFTER the probe: helper_funcs
+    # pulls in jax.numpy, and a broken backend must die inside the
+    # probe's failure-JSON envelope, not as a bare import traceback
+    # with an empty stdout (the r04 blind spot all over again)
     from theanompi_tpu.utils.helper_funcs import (
         COMPILATION_CACHE_ENV,
         enable_compilation_cache,
@@ -374,15 +386,6 @@ def _main() -> int:
             os.path.dirname(os.path.abspath(__file__)),
             "artifacts", "jax_cache")
     enable_compilation_cache()
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        platform, err = "cpu", ""  # no tunnel involved; probe is moot
-    else:
-        _set_phase("probe")
-        with monitor.span("bench/probe"):
-            platform, err = _probe_backend()
-    if platform is None:
-        print(_failure_json(f"no measurement taken — {err}"), flush=True)
-        return 1
     _set_phase(f"measure ({platform})")
     _heartbeat(f"backend up: {platform}; building model")
 

@@ -1,0 +1,23 @@
+"""TM401/TM403 seeded-bad corpus (paired with coverage_docs.md).
+
+The docs twin documents site ``alpha`` and metric ``corpus/a_total``
+(in sync), plus site ``beta`` and metric ``corpus/ghost_total`` that
+this module never produces (TM402/TM404 fire on the DOCS lines); this
+module additionally fires ``undocumented_site`` and emits
+``corpus/b_ms`` that the docs lack (TM401/TM403 fire here).
+"""
+
+from theanompi_tpu import monitor
+from theanompi_tpu.resilience import faults
+
+
+def documented_pair(x):
+    faults.fire("alpha", worker=1)
+    monitor.inc("corpus/a_total", op="x")
+    return x
+
+
+def undocumented_pair(x):
+    faults.fire("undocumented_site", step=2)  # SEED: TM401
+    monitor.observe("corpus/b_ms", 1.0)       # SEED: TM403
+    return x

@@ -1,0 +1,62 @@
+"""TM201 seeded-bad corpus: uses-after-donate the checker must flag."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(state, grads):
+    return jax.tree.map(lambda s, g: s - g, state, grads)
+
+
+def build_step(donate: bool = True):
+    def step(state, batch):
+        return state
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def simple_use_after_donate(state, grads):
+    new = update(state, grads)
+    return new, jnp.sum(state["w"])  # SEED: TM201 (state donated above)
+
+
+def attr_use_after_donate(model, grads):
+    out = update(model.state.params, grads)
+    norm = model.state.params["w"].sum()  # SEED: TM201
+    return out, norm
+
+
+def factory_use_after_donate(state, batch):
+    step = build_step()
+    new = step(state, batch)
+    return new, state  # SEED: TM201 (factory-built step donates arg 0)
+
+
+def _dyn_spec(donate, donate_batch):
+    return (0, 1) if donate_batch else (0,)
+
+
+def build_staged_step():
+    def step(state, batch):
+        return state
+
+    # dynamic donate spec (the bsp/zero/fsdp builder shape): the lint
+    # must assume the state+staged-batch (0, 1) donation
+    return jax.jit(step, donate_argnums=_dyn_spec(True, True))
+
+
+def staged_batch_use_after_donate(state, batch):
+    step = build_staged_step()
+    new = step(state, batch)
+    return new, batch  # SEED: TM201 (batch donated at position 1)
+
+
+def post_branch_use_after_donate(state, grads, flag):
+    if flag:
+        new = update(state, grads)
+    else:
+        new = state
+    return new, state  # SEED: TM201 (donated in one branch -> dead after)

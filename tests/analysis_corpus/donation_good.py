@@ -1,0 +1,65 @@
+"""TM201 known-good twin: donation followed by legal access only."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(state, grads):
+    return jax.tree.map(lambda s, g: s - g, state, grads)
+
+
+def rebind_same_name(state, grads):
+    # x = f(x): the call consumes the old binding, the store installs
+    # the result — nothing dangling
+    state = update(state, grads)
+    return state["w"]
+
+
+def rebind_attribute(model, grads):
+    model.state = model.state.replace(
+        params=update(model.state.params, grads))
+    return model.state.params
+
+
+def read_before_donate(state, grads):
+    norm = state["w"].sum()
+    new = update(state, grads)
+    return new, norm
+
+
+def donate_expression_arg(state, grads):
+    # the donated position holds an expression, not a simple path —
+    # nothing to track, nothing to flag
+    new = update(dict(state), grads)
+    return new, state
+
+
+def suppressed(state, grads):
+    new = update(state, grads)
+    return new, state  # lint: ok TM201
+
+
+def _plain_step(state):
+    return state
+
+
+#: the idiomatic explicit NO-donate spec — must not register as
+#: donating argument 0
+keep_step = jax.jit(_plain_step, donate_argnums=())
+
+
+def explicit_empty_donate(state):
+    new = keep_step(state)
+    return new, state
+
+
+def exclusive_branches(state, grads, flag):
+    # a donation in one branch must not poison the OTHER branch's
+    # reads — the zoo's k>1/a>1/else step dispatch is exactly this
+    if flag:
+        out = update(state, grads)
+    else:
+        out = state["w"] + 1
+    return out

@@ -1,0 +1,36 @@
+"""TM101 seeded-bad corpus: every marked line must be flagged.
+
+A ``SEED:`` comment with a check ID marks the exact line the checker
+must report (tests/test_analysis.py asserts line numbers match).
+"""
+
+import threading
+
+
+class LeakyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._count = 0       # guarded_by: self._lock
+        self._pending = []    # guarded_by: self._cond
+        self.public = 0       # undeclared: never checked
+
+    def locked_inc(self):
+        with self._lock:
+            self._count += 1
+
+    def cond_push(self, item):
+        with self._cond:
+            self._pending.append(item)
+            self._cond.notify_all()
+
+    def bare_read(self):
+        return self._count  # SEED: TM101
+
+    def bare_write(self):
+        self._pending = []  # SEED: TM101
+
+    def half_locked(self):
+        with self._lock:
+            n = self._count
+        return n + self._count  # SEED: TM101 (second read is outside)

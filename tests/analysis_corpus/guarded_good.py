@@ -1,0 +1,43 @@
+"""TM101 known-good twin: the checker must stay silent here.
+
+Exercises every escape the convention defines: with-blocks on the lock
+AND on its Condition alias, the ``requires_lock`` method annotation,
+constructor exemption, inline suppression, and undeclared attributes.
+"""
+
+import threading
+
+
+class TidyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._count = 0       # guarded_by: self._lock
+        self._pending = []    # guarded_by: self._cond
+        self.public = 0
+        self._count = self.public  # constructor access is exempt
+
+    def locked_inc(self):
+        with self._lock:
+            self._count += 1
+            return self._count
+
+    def cond_wait(self):
+        with self._cond:
+            while not self._pending:
+                self._cond.wait(0.1)
+            return self._pending.pop()
+
+    def alias_ok(self):
+        # the Condition wraps the same lock, so either name guards both
+        with self._cond:
+            self._count += len(self._pending)
+
+    def helper(self):  # requires_lock: self._lock
+        return self._count
+
+    def suppressed(self):
+        return self._count  # lint: ok TM101
+
+    def unguarded_public(self):
+        return self.public  # undeclared attr: not checked

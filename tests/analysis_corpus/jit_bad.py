@@ -1,0 +1,37 @@
+"""TM301/TM302 seeded-bad corpus."""
+
+import pickle
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_sync(x):
+    return x * x.item()  # SEED: TM301 (.item in a jitted fn)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def partial_decorated_sync(x):
+    return jnp.asarray(np.asarray(x))  # SEED: TM301 (np.asarray)
+
+
+def helper(x):
+    return float(x) * 2.0  # SEED: TM301 (scalar coercion, reachable)
+
+
+def traced(x):
+    return helper(x) + 1
+
+
+traced_step = jax.jit(traced)
+
+
+def decode_frame(buf):
+    return pickle.loads(buf)  # SEED: TM302 (no allow_pickle guard)
+
+
+def load_numpy(path):
+    return np.load(path, allow_pickle=True)  # SEED: TM302

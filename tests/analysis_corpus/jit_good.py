@@ -1,0 +1,33 @@
+"""TM301/TM302 known-good twin."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_step(x):
+    # shape-derived scalars are static under tracing: not host syncs
+    rows = int(x.shape[0])
+    scale = float(len(x.shape))
+    return jnp.sum(x) / (rows * scale)
+
+
+def host_helper(x):
+    # host-side code may sync freely: this function is NOT reachable
+    # from any traced root
+    return float(np.asarray(x).item())
+
+
+def gated_decode(buf, opts):
+    # the wire-v2 pattern: the pickle escape is reachable only behind
+    # an explicit allow_pickle opt-in that raises when off
+    if not opts.allow_pickle:
+        raise ValueError("frame carries pickle but allow_pickle=False")
+    return pickle.loads(buf)
+
+
+def safe_numpy_load(path):
+    return np.load(path)  # allow_pickle defaults to False

@@ -17,6 +17,15 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
+# lock-order detection (analysis/lockgraph.py): tier-1 always runs the
+# threaded host plane (_ExchangePipe, DynamicBatcher, WorkerSupervisor,
+# InferenceServer) on TrackedLock, so an AB/BA inversion introduced by
+# any PR raises LockOrderError in the test that exercises it instead of
+# deadlocking until the CI timeout (docs/ANALYSIS.md)
+os.environ.setdefault("THEANOMPI_TPU_LOCKCHECK", "1")
+
+import threading  # noqa: E402
+import time  # noqa: E402
 
 import jax
 
@@ -45,6 +54,56 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+#: repo thread families that hold closures over models/clients — a
+#: test that leaks one pins device buffers and sockets for the rest of
+#: the session, so these fail the leak guard even though they are
+#: daemonic (daemon= only means the INTERPRETER may exit; the suite
+#: keeps running)
+_REPO_THREAD_NAMES = ("-exchange-", "serving-batcher-",
+                      "serving-reload-watcher", "monitor-heartbeat-")
+#: library pools that are non-daemon BY DESIGN and process-lived
+#: (concurrent.futures executors inside jax/orbax) — not leaks
+_POOL_THREAD_PREFIXES = ("ThreadPoolExecutor", "asyncio_", "grpc",
+                         "orbax")
+
+
+def leaked_threads(before: set, grace_s: float = 2.0) -> list:
+    """Threads started since ``before`` that are still alive after the
+    grace window and are either non-daemon (excluding known library
+    pools) or members of a repo thread family.  Exposed as a plain
+    function so tests/test_analysis.py can pin the detection itself."""
+    deadline = time.monotonic() + grace_s
+    while True:
+        fresh = [t for t in threading.enumerate()
+                 if t not in before and t.is_alive()]
+        leaked = [
+            t for t in fresh
+            if (not t.daemon
+                and not t.name.startswith(_POOL_THREAD_PREFIXES))
+            or any(p in t.name for p in _REPO_THREAD_NAMES)
+        ]
+        if not leaked or time.monotonic() > deadline:
+            return leaked
+        time.sleep(0.05)
+
+
+@pytest.fixture(autouse=True)
+def thread_leak_guard():
+    """Tier-1 leak fence: every test must stop what it starts — a
+    leaked `_ExchangePipe`/batcher/watcher/heartbeat thread fails the
+    leaking test by name, not some later test by mystery."""
+    before = set(threading.enumerate())
+    yield
+    leaked = leaked_threads(before)
+    if leaked:
+        names = ", ".join(f"{t.name}(daemon={t.daemon})"
+                          for t in leaked)
+        pytest.fail(f"test leaked {len(leaked)} thread(s): {names} — "
+                    "close/stop the owning object (pipe.close(), "
+                    "batcher.stop(), server.stop(), monitor session "
+                    "exit) before returning")
 
 
 @pytest.fixture(scope="session")

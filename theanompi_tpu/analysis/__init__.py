@@ -1,0 +1,40 @@
+"""theanompi_tpu.analysis — repo-native correctness tooling.
+
+Two planes (docs/ANALYSIS.md is the operator's reference):
+
+* **static** — the ``tmlint`` checker suite (pure stdlib ``ast``; no
+  jax import, no network): guarded-by lint (TM101), use-after-donate
+  lint (TM201), jit-hygiene + pickle-reachability lints (TM301/TM302),
+  and the docs/instrumentation site-coverage lint (TM401–TM404), all
+  gated on zero NEW findings vs ``analysis/baseline.json``;
+* **runtime** — ``lockgraph``: an instrumented :class:`TrackedLock` +
+  global acquisition-order graph that raises on order cycles (deadlock
+  potential), swapped into the threaded host plane under
+  ``THEANOMPI_TPU_LOCKCHECK=1`` (tier-1 sets it).
+
+The static plane deliberately does not import the checked code —
+checkers parse source, so ``tmlint --gate`` runs in seconds on a cold
+CPU box and cannot be wedged by a broken device runtime.
+"""
+
+from theanompi_tpu.analysis.common import (
+    CHECK_IDS,
+    Finding,
+    load_baseline,
+    split_by_baseline,
+    write_baseline,
+)
+from theanompi_tpu.analysis.lockgraph import (
+    GRAPH,
+    LockGraph,
+    LockOrderError,
+    TrackedLock,
+    make_condition,
+    make_lock,
+)
+
+__all__ = [
+    "CHECK_IDS", "Finding", "GRAPH", "LockGraph", "LockOrderError",
+    "TrackedLock", "load_baseline", "make_condition", "make_lock",
+    "split_by_baseline", "write_baseline",
+]

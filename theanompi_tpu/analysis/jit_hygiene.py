@@ -1,0 +1,275 @@
+"""TM301/TM302 — jit-hygiene and pickle-reachability lints.
+
+**TM301 (host sync in a jit-reachable function).**  A function traced
+by ``jax.jit`` / ``shard_map`` must not synchronize with the host:
+``.item()``, ``np.asarray``/``np.array`` on device values,
+``jax.device_get``, and ``float()``/``int()``/``bool()`` coercions of
+traced values either fail under tracing or — worse — silently constant-
+fold a runtime value at trace time.  The pass builds a per-module call
+graph, roots it at everything handed to ``jax.jit`` / ``shard_map`` /
+``pallas_call`` (decorators, wrapper assignments, builder returns) and
+flags host-sync calls in any reachable function.  Scalar coercions of
+shape-like expressions (``int(x.shape[0])``, ``len(...)``) are static
+under tracing and are not flagged.
+
+**TM302 (unguarded pickle decode).**  ``pickle.loads``/``pickle.load``
+executes arbitrary code from the payload.  PR 5's wire v2 pinned
+``allow_pickle=False`` for frames the server decodes; this check pins
+it *structurally*: every pickle decode in the package must sit in a
+function that first checks an ``allow_pickle`` flag and raises when it
+is off (the ``_decode_node`` pattern), or carry a baseline suppression
+with a reason (e.g. trusted local dataset files).  ``np.load(...,
+allow_pickle=True)`` is flagged the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from theanompi_tpu.analysis.common import (
+    Finding,
+    SourceFile,
+    call_name,
+    dotted_name,
+    make_key,
+)
+
+CHECK_HOST_SYNC = "TM301"
+CHECK_PICKLE = "TM302"
+
+#: callables that wrap a function into a traced program
+_TRACER_WRAPPERS = {"jit", "shard_map", "pallas_call", "pmap", "vmap",
+                    "grad", "value_and_grad"}
+#: of those, the ones that actually root a hot path (vmap/grad alone
+#: run eagerly; they still matter when the result is jitted, which the
+#: wrapper-of-wrapper scan below catches via the outer jit)
+_ROOT_WRAPPERS = {"jit", "shard_map", "pallas_call", "pmap"}
+
+#: dotted call names that force a host sync
+_HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "jax.device_get", "np.copy"}
+#: method names on any object that force a host sync
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+_SCALAR_COERCIONS = {"float", "int", "bool"}
+
+
+def _is_shape_like(node: ast.AST) -> bool:
+    """Static-under-tracing expressions: anything touching ``.shape``,
+    ``.ndim``, ``.size``, ``len()``, or plain constants/arithmetic on
+    them."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "itemsize", "dtype"):
+            return True
+        if isinstance(sub, ast.Call):
+            n = (dotted_name(sub.func) or "").split(".")[-1]
+            if n in ("len", "axis_size", "psum_scatter"):
+                return True
+    return all(isinstance(s, (ast.Constant, ast.BinOp, ast.UnaryOp,
+                              ast.operator, ast.unaryop, ast.expr_context))
+               for s in ast.walk(node)) if isinstance(
+                   node, (ast.Constant, ast.BinOp, ast.UnaryOp)) else False
+
+
+# ---------------------------------------------------------------------------
+# Call graph per module
+# ---------------------------------------------------------------------------
+
+
+class _Scope:
+    """One function in the module graph."""
+
+    def __init__(self, qual: str, node: ast.FunctionDef,
+                 cls: str | None):
+        self.qual = qual
+        self.node = node
+        self.cls = cls
+        self.calls: set[str] = set()       # plain names called
+        self.self_calls: set[str] = set()  # self.<m>() method calls
+
+
+def _collect_scopes(src: SourceFile) -> dict[str, list[_Scope]]:
+    """name -> scopes with that (unqualified) name in this module."""
+    scopes: dict[str, list[_Scope]] = {}
+
+    def visit(node: ast.AST, prefix: str, cls: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                sc = _Scope(f"{prefix}{child.name}", child, cls)
+                scopes.setdefault(child.name, []).append(sc)
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call):
+                        d = dotted_name(sub.func)
+                        if d is None:
+                            continue
+                        if "." not in d:
+                            sc.calls.add(d)
+                        elif d.startswith("self.") and d.count(".") == 1:
+                            sc.self_calls.add(d.split(".", 1)[1])
+                visit(child, f"{prefix}{child.name}.", cls)
+            else:
+                visit(child, prefix, cls)
+
+    visit(src.tree, "", None)
+    return scopes
+
+
+def _root_names(src: SourceFile) -> set[str]:
+    """Unqualified names of functions handed to a tracing wrapper."""
+    roots: set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = (dotted_name(target) or "").split(".")[-1]
+                if name in _ROOT_WRAPPERS:
+                    roots.add(node.name)
+                elif name == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args:
+                    inner = (dotted_name(dec.args[0]) or "").split(".")[-1]
+                    if inner in _ROOT_WRAPPERS:
+                        roots.add(node.name)
+        elif isinstance(node, ast.Call):
+            name = (call_name(node) or "").split(".")[-1]
+            if name in _ROOT_WRAPPERS and node.args:
+                ref = dotted_name(node.args[0])
+                if ref is not None:
+                    roots.add(ref.split(".")[-1])
+    return roots
+
+
+def _reachable(scopes: dict[str, list[_Scope]],
+               roots: set[str]) -> list[_Scope]:
+    seen: set[str] = set()
+    work = [s for name in roots for s in scopes.get(name, [])]
+    out: list[_Scope] = []
+    while work:
+        sc = work.pop()
+        if sc.qual in seen:
+            continue
+        seen.add(sc.qual)
+        out.append(sc)
+        for callee in sc.calls | sc.self_calls:
+            for nxt in scopes.get(callee, []):
+                # self.m() resolves within the same class only
+                if callee in sc.self_calls and nxt.cls != sc.cls \
+                        and callee not in sc.calls:
+                    continue
+                work.append(nxt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TM301
+# ---------------------------------------------------------------------------
+
+
+def check_host_sync(src: SourceFile) -> list[Finding]:
+    scopes = _collect_scopes(src)
+    roots = _root_names(src)
+    if not roots:
+        return []
+    findings: list[Finding] = []
+    reported: set[str] = set()
+    for sc in _reachable(scopes, roots):
+        for node in ast.walk(sc.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            label = None
+            if d in _HOST_SYNC_CALLS:
+                label = f"{d}()"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_METHODS \
+                    and not node.args:
+                label = f".{node.func.attr}()"
+            elif d in _SCALAR_COERCIONS and node.args \
+                    and not isinstance(node.args[0], ast.Constant) \
+                    and not _is_shape_like(node.args[0]):
+                label = f"{d}()"
+            if label is None \
+                    or src.suppressed(node.lineno, CHECK_HOST_SYNC):
+                continue
+            key = make_key(CHECK_HOST_SYNC, src.relpath, sc.qual, label)
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                CHECK_HOST_SYNC, src.relpath, node.lineno,
+                f"host-sync {label} inside '{sc.qual}', which is "
+                f"reachable from a jax.jit/shard_map hot path", key))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# TM302
+# ---------------------------------------------------------------------------
+
+
+def _has_allow_pickle_guard(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If):
+            test_names = {dotted_name(s) or getattr(s, "attr", "")
+                          for s in ast.walk(node.test)
+                          if isinstance(s, (ast.Name, ast.Attribute))}
+            if any("allow_pickle" in (n or "") for n in test_names):
+                if any(isinstance(s, ast.Raise)
+                       for s in ast.walk(node)):
+                    return True
+    return False
+
+
+def check_pickle(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    # map each pickle decode to its innermost enclosing function
+    def visit(node: ast.AST, fn_stack: list[ast.AST], qual: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, fn_stack + [child], f"{qual}{child.name}.")
+                continue
+            if isinstance(child, ast.ClassDef):
+                visit(child, fn_stack, f"{qual}{child.name}.")
+                continue
+            if isinstance(child, ast.Call):
+                d = dotted_name(child.func) or ""
+                flagged = None
+                if d in ("pickle.loads", "pickle.load",
+                         "cPickle.loads", "cPickle.load"):
+                    flagged = d
+                elif d.endswith(("np.load", "numpy.load")) or d == "np.load":
+                    for kw in child.keywords:
+                        if kw.arg == "allow_pickle" \
+                                and isinstance(kw.value, ast.Constant) \
+                                and kw.value.value is True:
+                            flagged = f"{d}(allow_pickle=True)"
+                if flagged and not src.suppressed(child.lineno,
+                                                 CHECK_PICKLE):
+                    guarded = any(_has_allow_pickle_guard(fn)
+                                  for fn in fn_stack)
+                    if not guarded:
+                        scope = qual.rstrip(".") or "<module>"
+                        key = make_key(CHECK_PICKLE, src.relpath, scope)
+                        if not any(f.key == key for f in findings):
+                            findings.append(Finding(
+                                CHECK_PICKLE, src.relpath, child.lineno,
+                                f"{flagged} in '{scope}' without an "
+                                f"allow_pickle guard (wire-v2 servers "
+                                f"decode with allow_pickle=False; "
+                                f"arbitrary-code-execution surface)",
+                                key))
+            visit(child, fn_stack, qual)
+
+    visit(src.tree, [], "")
+    return findings
+
+
+def run(files: list[SourceFile]) -> list[Finding]:
+    out: list[Finding] = []
+    for src in files:
+        out.extend(check_host_sync(src))
+        out.extend(check_pickle(src))
+    return out

@@ -1,0 +1,204 @@
+"""Runtime lock-order detector for the threaded host plane.
+
+Static passes (guarded_by.py) prove accesses happen *under* a lock;
+they cannot prove two locks are always taken in the same *order*.  An
+AB/BA inversion between, say, a batcher's condition and the reload
+watcher's lock deadlocks only under exact interleaving — the kind of
+bug that survives a thousand green CI runs and kills the first
+production incident.
+
+:class:`TrackedLock` wraps ``threading.Lock`` and maintains
+
+* a **per-thread held stack** of lock *site names* (one name per
+  construction site, e.g. ``"DynamicBatcher._lock"`` — instances share
+  the name, because ordering discipline is defined per site, not per
+  object);
+* a **global acquisition-order graph**: acquiring B while holding A
+  records the edge A→B.  Before recording, the graph is checked for a
+  path B→…→A; if one exists, the new edge closes a cycle and
+  :class:`LockOrderError` is raised **at acquire time, before
+  blocking** — the test fails with the full cycle spelled out instead
+  of hanging until the CI timeout.
+
+Acquiring a lock object already held by the same thread with
+``blocking=True`` raises immediately (``threading.Lock`` is not
+reentrant — that IS the deadlock).  A non-blocking attempt on a held
+lock is allowed through untracked, because
+``threading.Condition._is_owned`` probes ownership exactly that way.
+Re-acquire detection is per lock INSTANCE: two objects constructed at
+the same site (two batcher replicas) share a graph node but nest
+freely — the site-level graph deliberately records no same-site
+self-edges, so opposite-order nesting of two same-site instances is
+outside its reach.
+
+Activation: :func:`make_lock` / :func:`make_condition` are the
+construction seam used by ``_ExchangePipe``, ``DynamicBatcher``,
+``WorkerSupervisor``, and ``InferenceServer``.  With
+``THEANOMPI_TPU_LOCKCHECK=1`` (tier-1 sets it in ``tests/conftest.py``)
+they return tracked objects; otherwise plain ``threading`` primitives
+with zero overhead.  ``threading.Condition`` composes transparently:
+its ``wait()`` releases/reacquires via the tracked ``acquire``/
+``release``, so the held stack stays truthful across waits.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+ENV_VAR = "THEANOMPI_TPU_LOCKCHECK"
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition that would close an order cycle (deadlock
+    potential), or a same-thread re-acquire of a non-reentrant site."""
+
+
+class LockGraph:
+    """Global site-level acquisition-order graph."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        #: edge A -> {B: "threadname"}: B was acquired while A held
+        self._edges: dict[str, dict[str, str]] = {}
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+
+    def edges(self) -> dict[str, set[str]]:
+        with self._mu:
+            return {a: set(bs) for a, bs in self._edges.items()}
+
+    def note_acquire(self, name: str, held: tuple[str, ...]) -> None:
+        """Record held->name edges; raise on a cycle BEFORE the caller
+        blocks on the real lock."""
+        if not held:
+            return
+        cycle: list[str] | None = None
+        with self._mu:
+            for h in held:
+                if h == name:
+                    continue  # same-site nesting is checked per-thread
+                    # by TrackedLock (instances may differ)
+                targets = self._edges.setdefault(h, {})
+                if name in targets:
+                    continue
+                path = self._path(name, h)
+                if path is not None:
+                    cycle = [h] + path
+                    break
+                targets[name] = threading.current_thread().name
+        if cycle is not None:
+            # cycle is already closed: [h, name, ..., h]
+            chain = " -> ".join(cycle)
+            raise LockOrderError(
+                f"lock-order cycle: acquiring '{name}' while holding "
+                f"'{cycle[0]}' inverts the established order "
+                f"{chain} (each '->' is an acquired-while-holding "
+                f"edge recorded this run); two threads taking these "
+                f"sites in opposite orders can deadlock")
+
+    def _path(self, src: str, dst: str) -> list[str] | None:
+        """DFS path src -> ... -> dst over recorded edges (caller holds
+        self._mu)."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, {}):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+
+#: the process-wide graph (tests may reset() it)
+GRAPH = LockGraph()
+
+_tls = threading.local()
+
+
+def _held_stack() -> list[tuple[str, int]]:
+    """Per-thread stack of (site name, lock instance id).  Edges in
+    the graph are site-level, but re-acquire detection and release
+    bookkeeping must be INSTANCE-level: two batcher replicas share the
+    site name, and nesting their two distinct locks is legal."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class TrackedLock:
+    """``threading.Lock`` with held-stack + order-graph bookkeeping.
+    Duck-compatible with ``threading.Condition``'s expectations."""
+
+    def __init__(self, name: str, graph: LockGraph | None = None):
+        self.name = name
+        self._graph = graph or GRAPH
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        stack = _held_stack()
+        if any(iid == id(self) for _, iid in stack):
+            # THIS lock object is already held by this thread
+            if blocking:
+                raise LockOrderError(
+                    f"same-thread re-acquire of non-reentrant lock "
+                    f"site '{self.name}' (held: "
+                    f"{[n for n, _ in stack]}) — this deadlocks a "
+                    f"threading.Lock")
+            # Condition._is_owned probes with acquire(False); an
+            # already-held lock must simply fail the probe
+            return self._lock.acquire(False)
+        self._graph.note_acquire(self.name,
+                                 tuple(n for n, _ in stack))
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            stack.append((self.name, id(self)))
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == id(self):
+                del stack[i]
+                break
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"TrackedLock({self.name!r}, locked={self.locked()})"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "") not in ("", "0", "false")
+
+
+def make_lock(name: str):
+    """The construction seam: a :class:`TrackedLock` under
+    ``THEANOMPI_TPU_LOCKCHECK=1``, else a plain ``threading.Lock``."""
+    if enabled():
+        return TrackedLock(name)
+    return threading.Lock()
+
+
+def make_condition(lock=None, name: str = "condition"):
+    """``threading.Condition`` over ``lock`` (tracked or plain).  With
+    no lock given, the condition's internal lock follows the same
+    enablement rule as :func:`make_lock`."""
+    return threading.Condition(lock if lock is not None
+                               else make_lock(name))

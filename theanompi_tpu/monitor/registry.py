@@ -168,14 +168,14 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._series: dict[tuple[str, LabelKey], Any] = {}
-        self._kinds: dict[str, str] = {}
-        self.write_count = 0
+        self._series: dict[tuple[str, LabelKey], Any] = {}  # guarded_by: self._lock
+        self._kinds: dict[str, str] = {}                    # guarded_by: self._lock
+        self.write_count = 0                                # guarded_by: self._lock
         self.created_at = time.time()
 
     # -- series access -------------------------------------------------
 
-    def _get(self, kind: str, name: str, labels: dict[str, Any]):
+    def _get(self, kind: str, name: str, labels: dict[str, Any]):  # requires_lock: self._lock
         declared = self._kinds.setdefault(name, kind)
         if declared != kind:
             raise TypeError(

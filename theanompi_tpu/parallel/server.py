@@ -48,9 +48,9 @@ class EASGDServer:
 
     def __init__(self, params: PyTree, alpha: float = 0.5):
         self.alpha = alpha
-        self._center = jax.tree.map(np.asarray, params)
+        self._center = jax.tree.map(np.asarray, params)  # guarded_by: self._lock
         self._lock = threading.Lock()
-        self.n_exchanges = 0
+        self.n_exchanges = 0  # guarded_by: self._lock
 
     def exchange(self, worker_params: PyTree) -> PyTree:
         """One elastic exchange; returns the worker's new params.
@@ -91,11 +91,11 @@ class ASGDServer:
 
     def __init__(self, params: PyTree,
                  tx: optax.GradientTransformation):
-        self._center = params
+        self._center = params            # guarded_by: self._lock
         self.tx = tx
-        self._opt_state = tx.init(params)
+        self._opt_state = tx.init(params)  # guarded_by: self._lock
         self._lock = threading.Lock()
-        self.n_updates = 0
+        self.n_updates = 0               # guarded_by: self._lock
 
         @jax.jit
         def _apply(params, opt_state, grads):

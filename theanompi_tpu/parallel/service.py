@@ -553,7 +553,8 @@ class ServiceClient:
         #: negotiated per-connection: None = v1 pickle
         self._wire: wire.WireOptions | None = None
         self._lock = threading.Lock()
-        self._conn = Client(self.address, authkey=self._authkey)
+        self._conn = Client(self.address,   # guarded_by: self._lock
+                            authkey=self._authkey)
         self._negotiate()
 
     # -- transport -----------------------------------------------------
@@ -715,8 +716,13 @@ class ServiceClient:
         return payload
 
     def close(self) -> None:
+        # Deliberately does NOT take self._lock: an RPC thread wedged
+        # in a blocking v1 recv holds the lock indefinitely, and
+        # closing the fd out from under it is the only way another
+        # thread can unstick it (the recv raises OSError/EOFError and
+        # the retry loop surfaces it).  Liveness beats tidiness here.
         try:
-            self._conn.close()
+            self._conn.close()  # lint: ok TM101
         except OSError:
             pass
 

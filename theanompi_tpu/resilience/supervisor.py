@@ -41,6 +41,7 @@ import time
 from typing import Callable, Sequence
 
 from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
 from theanompi_tpu.resilience.retry import RetryPolicy
 
 
@@ -67,10 +68,10 @@ class WorkerSupervisor:
         self.backoff = backoff or RetryPolicy(
             max_attempts=max(2, max_restarts + 1), base_delay=0.1,
             max_delay=2.0, name=f"{name}-restart")
-        self._lock = threading.Lock()
-        self._restarts: dict[int, int] = {}
-        self._lost: set[int] = set()
-        self._stragglers: set[int] = set()
+        self._lock = make_lock("WorkerSupervisor._lock")
+        self._restarts: dict[int, int] = {}   # guarded_by: self._lock
+        self._lost: set[int] = set()          # guarded_by: self._lock
+        self._stragglers: set[int] = set()    # guarded_by: self._lock
 
     # -- introspection (rules put these in their result dict) ----------
 
@@ -127,7 +128,13 @@ class WorkerSupervisor:
                         fn(abort)
                         return
                     except BaseException as e:
-                        if not self._handle_failure(rank, e, errors, abort):
+                        # TM101 regression: the restart ordinal is
+                        # returned from under _handle_failure's lock —
+                        # the old bare self._restarts.get() here raced
+                        # other workers' failure bookkeeping
+                        attempt = self._handle_failure(
+                            rank, e, errors, abort)
+                        if not attempt:
                             return
                         try:
                             if self.restart_from is not None:
@@ -139,8 +146,7 @@ class WorkerSupervisor:
                                 errors.append(e2)
                             abort.set()
                             return
-                        time.sleep(self.backoff.delay(
-                            self._restarts.get(rank, 1) - 1))
+                        time.sleep(self.backoff.delay(attempt - 1))
             return threading.Thread(target=loop, daemon=True,
                                     name=f"{self.name}-worker{rank}")
 
@@ -166,13 +172,14 @@ class WorkerSupervisor:
 
     def _handle_failure(self, rank: int, e: BaseException,
                         errors: list[BaseException],
-                        abort: threading.Event) -> bool:
-        """Decide restart (True) vs stop-this-thread (False); flips the
-        session abort when the error is fatal or quorum is lost."""
+                        abort: threading.Event) -> int:
+        """Decide restart (returns the 1-based restart ordinal) vs
+        stop-this-thread (returns 0); flips the session abort when the
+        error is fatal or quorum is lost."""
         recoverable = isinstance(e, Exception)
         with self._lock:
             if abort.is_set():
-                return False
+                return 0
             n = self._restarts.get(rank, 0)
             if (recoverable and self.restart_from is not None
                     and n < self.max_restarts):
@@ -183,7 +190,7 @@ class WorkerSupervisor:
                       file=sys.stderr, flush=True)
                 monitor.inc("resilience/worker_restarts_total",
                             worker=rank)
-                return True
+                return n + 1
             self._lost.add(rank)
             alive = self.n_workers - len(self._lost)
             monitor.inc("resilience/workers_lost_total", worker=rank)
@@ -195,8 +202,10 @@ class WorkerSupervisor:
                       "aborting session", file=sys.stderr, flush=True)
                 errors.append(e)
                 abort.set()
-                return False
-        # outside the lock: the hook may do service I/O
+                return 0
+        # outside the lock: the hook may do service I/O.  ``alive`` was
+        # computed under the lock — the old f-string re-read self._lost
+        # bare here (TM101)
         if self.on_lost is not None:
             try:
                 self.on_lost(rank)
@@ -205,6 +214,5 @@ class WorkerSupervisor:
                       f"{hook_err}", file=sys.stderr, flush=True)
         print(f"[resilience] {self.name} worker {rank} lost "
               f"({type(e).__name__}: {e}); continuing with "
-              f"{self.n_workers - len(self._lost)} worker(s)",
-              file=sys.stderr, flush=True)
-        return False
+              f"{alive} worker(s)", file=sys.stderr, flush=True)
+        return 0

@@ -45,6 +45,7 @@ import numpy as np
 import queue
 
 from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
 from theanompi_tpu.models.base import TpuModel
 from theanompi_tpu.parallel.exchanger import (
     easgd_apply_delta,
@@ -124,8 +125,9 @@ class _ExchangePipe:
         self._worker = str(worker)
         self._req: queue.Queue = queue.Queue(maxsize=1)
         self._res: queue.Queue = queue.Queue(maxsize=1)
-        self._err: BaseException | None = None
-        self.outstanding = False
+        self._lock = make_lock("_ExchangePipe._lock")
+        self._err: BaseException | None = None  # guarded_by: self._lock
+        self.outstanding = False                # guarded_by: self._lock
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"{name}-exchange-w{worker}")
@@ -144,27 +146,49 @@ class _ExchangePipe:
                 out = (None, e)
             self._res.put((item, out))
 
+    def busy(self) -> bool:
+        """Locked read of the barrier flag — the worker loop's drain
+        checks go through here so every access of the guarded state
+        honors the declared discipline."""
+        with self._lock:
+            return self.outstanding
+
     def submit(self, payload) -> None:
         """Hand one host payload to the exchange thread (returns
         immediately).  A prior failure or an already-outstanding
         exchange raises here."""
-        if self._err is not None:
-            raise self._err
-        if self.outstanding:
-            raise RuntimeError(
-                f"{self._name}: bounded-staleness barrier — at most one "
-                "exchange may be outstanding; collect() first")
-        self._req.put(payload)
-        self.outstanding = True
+        # the barrier flag and the sticky error are declared
+        # guarded_by this lock: today a pipe is owned by exactly one
+        # worker thread, so the lock buys visibility/discipline rather
+        # than fixing a live race — but it keeps check-then-set atomic
+        # if the ownership story ever changes, at nanoseconds of cost
+        with self._lock:
+            if self._err is not None:
+                raise self._err
+            if self.outstanding:
+                raise RuntimeError(
+                    f"{self._name}: bounded-staleness barrier — at most "
+                    "one exchange may be outstanding; collect() first")
+            self.outstanding = True
+        try:
+            # queue put outside the lock: it can block when the
+            # exchange thread still holds the previous item
+            self._req.put(payload)
+        except BaseException:
+            with self._lock:
+                self.outstanding = False
+            raise
 
     def collect(self):
         """Block for the in-flight exchange; returns (payload, result).
         Re-raises the exchange thread's exception (incl. injected
         faults) in the worker thread."""
         payload, (result, err) = self._res.get()
-        self.outstanding = False
+        with self._lock:
+            self.outstanding = False
+            if err is not None:
+                self._err = err
         if err is not None:
-            self._err = err
             raise err
         return payload, result
 
@@ -387,7 +411,7 @@ class EASGD(_AsyncRule):
                                     model.state = model.state.replace(
                                         params=new_params)
                                 else:
-                                    if pipe.outstanding:
+                                    if pipe.busy():
                                         collect_and_correct()
                                     # host snapshot BEFORE the next
                                     # train dispatch can donate these
@@ -413,7 +437,7 @@ class EASGD(_AsyncRule):
                         model.adjust_hyperp(epoch + 1)
                         if rank == 0:
                             epoch_done.release()
-                    if pipe is not None and pipe.outstanding:
+                    if pipe is not None and pipe.busy():
                         collect_and_correct()  # drain the last one
                     # final elastic sync so worker state ~ center
                     model.state = model.state.replace(
@@ -624,7 +648,7 @@ class ASGD(_AsyncRule):
                                 # compute), then hand off this step's
                                 # grads
                                 new_params = model.state.params
-                                if pipe.outstanding:
+                                if pipe.busy():
                                     with monitor.span(
                                             "asgd/push_pull_collect",
                                             worker=str(rank)):
@@ -675,7 +699,7 @@ class ASGD(_AsyncRule):
                                     ),
                                     "epoch": epoch,
                                 })
-                    if pipe is not None and pipe.outstanding:
+                    if pipe is not None and pipe.busy():
                         # drain: the last grads must reach the center
                         # before the session's final validation
                         _, fresh = pipe.collect()

@@ -48,6 +48,7 @@ from typing import Callable
 import numpy as np
 
 from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
 
 
 class Overloaded(RuntimeError):
@@ -144,12 +145,12 @@ class DynamicBatcher:
         self.replica = int(replica)
         self._run_batch = run_batch
         self._on_batch_error = on_batch_error
-        self._q: deque[_Request] = deque()
-        self._qrows = 0
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._q: deque[_Request] = deque()      # guarded_by: self._lock
+        self._qrows = 0                         # guarded_by: self._lock
+        self._lock = make_lock("DynamicBatcher._lock")
+        self._cond = make_condition(self._lock)
         self._stop = threading.Event()
-        self._dead = False
+        self._dead = False                      # guarded_by: self._lock
         self._thread: threading.Thread | None = None
         # plain-int stats (read without the lock — torn reads of a
         # monotonically-increasing int are harmless for stats())
@@ -179,7 +180,14 @@ class DynamicBatcher:
 
     @property
     def alive(self) -> bool:
-        return not self._dead and not self._stop.is_set()
+        # _dead is declared guarded_by this lock, so the probe honors
+        # the discipline.  alive is inherently check-then-act either
+        # way — the server re-checks under the lock in submit() and
+        # converts a lost race into Overloaded failover; the cost here
+        # is one uncontended acquire per routing probe (the collector
+        # releases the lock while it waits in _collect).
+        with self._lock:
+            return not self._dead and not self._stop.is_set()
 
     def queue_depth(self) -> int:
         with self._lock:

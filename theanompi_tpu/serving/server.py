@@ -44,6 +44,7 @@ from typing import Any
 import numpy as np
 
 from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.serving.batcher import (
     BatchPolicy,
@@ -147,7 +148,7 @@ class InferenceServer:
         # instance — the ARRAYS still come from the verified export.
         self.model = (model if model is not None
                       else build_model_from_meta(loaded.meta, mesh=mesh))
-        self.version = loaded.version
+        self.version = loaded.version        # guarded_by: self._reload_lock
         self.replicas = [
             Replica(i, self.export_dir, self.policy, loaded, self.model,
                     max_restarts=max_restarts, donate=donate)
@@ -164,14 +165,14 @@ class InferenceServer:
                 # must take down served batches (supervised restart),
                 # not construction before the port is bound
                 r.batcher.warmup(shape, dtype, fn=r.session.infer)
-        self._rr = 0
-        self._rr_lock = threading.Lock()
+        self._rr_lock = make_lock("InferenceServer._rr_lock")
+        self._rr = 0                          # guarded_by: self._rr_lock
         self._stop = threading.Event()
         self._watcher: threading.Thread | None = None
-        self._reload_lock = threading.Lock()
+        self._reload_lock = make_lock("InferenceServer._reload_lock")
         #: newest published version that failed verification — skipped
         #: by the reload poll until a strictly newer one appears
-        self._bad_newest: int | None = None
+        self._bad_newest: int | None = None  # guarded_by: self._reload_lock
         monitor.set_gauge("serving/model_version", self.version)
         monitor.set_gauge("serving/replicas", len(self.replicas))
 
@@ -267,11 +268,19 @@ class InferenceServer:
     # -- introspection -------------------------------------------------
 
     def stats(self) -> dict:
-        reps = [dict(r.batcher.stats(), restarts=r.restarts,
-                     version=r.session.version)
-                for r in self.replicas]
+        # TM101 regression: the serving version is hot-reload state —
+        # replica stats AND the version are read under the reload lock
+        # so a concurrent swap cannot pair a new version with stats
+        # from the other side of it.  Cost: a stats() issued DURING a
+        # reload blocks until the verified load finishes — truthful,
+        # and only as long as the reload itself.
+        with self._reload_lock:
+            reps = [dict(r.batcher.stats(), restarts=r.restarts,
+                         version=r.session.version)
+                    for r in self.replicas]
+            version = self.version
         return {
-            "version": self.version,
+            "version": version,
             "replicas": reps,
             "batches": sum(r["batches"] for r in reps),
             "rows": sum(r["rows"] for r in reps),

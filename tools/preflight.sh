@@ -13,6 +13,13 @@ LOG="${1:-artifacts/preflight.log}"
 cd "$(dirname "$0")/.."
 {
   echo "# preflight $(date -u +%Y-%m-%dT%H:%M:%SZ) HEAD=$(git rev-parse --short HEAD)"
+  echo "## tmlint --gate (static checker suite, docs/ANALYSIS.md)"
+  # zero NEW findings vs analysis/baseline.json; pure-ast, seconds on
+  # CPU — runs FIRST so a locking/donation/doc-drift regression fails
+  # before the expensive suites even start
+  python tools/tmlint.py --gate
+  TMLINT_RC=$?
+  echo "tmlint rc=$TMLINT_RC"
   echo "## pytest slow-subset gate (-m gate)"
   # The tagged MUST-PASS slow subset (pyproject markers: 'gate') runs
   # as its OWN step so an environmental failure elsewhere in the full
@@ -201,8 +208,9 @@ PYEOF
   EXCHANGE_RC=$?
   rm -rf "$EXCHDIR"
   echo "exchange smoke rc=$EXCHANGE_RC"
-  if [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ]; then
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
+    [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
     exit 1
   fi
