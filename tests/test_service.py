@@ -284,6 +284,29 @@ def test_session_scoping_and_displacement(local_service):
         c.close()
 
 
+def test_v1_corrupt_pickle_typeerror_gets_diagnostic(local_service):
+    """A v1 request whose unpickle raises TypeError (e.g. a hostile
+    __reduce__ with bad args) must get the typed 'err' diagnostic and
+    leave the connection usable — not be mistaken for the
+    shutdown-closed-handle TypeError and silently dropped
+    (code-review regression guard for the conns close-sweep)."""
+    from multiprocessing.connection import Client as RawClient
+
+    host, _, port = local_service.rpartition(":")
+    key = os.environ["THEANOMPI_TPU_SERVICE_KEY"].encode()
+    conn = RawClient((host, int(port)), authkey=key)
+    try:
+        # pickle of int('a', 'b') — REDUCE raises TypeError at load
+        conn.send_bytes(b"cbuiltins\nint\n(S'a'\nS'b'\ntR.")
+        status, payload = conn.recv()
+        assert status == "err" and "TypeError" in payload
+        # connection survived the poison frame
+        conn.send(("ping",))
+        assert conn.recv() == ("ok", "pong")
+    finally:
+        conn.close()
+
+
 def test_malformed_requests_fail_cleanly():
     """Unknown ops and old-protocol requests (no session id) must get
     purposeful errors, not unpacking crashes or a params-tree-as-

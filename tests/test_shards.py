@@ -1,0 +1,690 @@
+"""Sharded parameter service (parallel/shards.py, ISSUE 8).
+
+The acceptance bar is EXACTNESS: the center pytree partitioned across
+K shard processes must be indistinguishable — byte-for-byte — from the
+single-center run at every exchange (the elastic update and the whole
+``build_optimizer`` zoo are per-leaf, and leaves are never split), and
+a checkpoint taken through the cross-shard version fence must restore
+a tree equal to SOME single global version, never a mix of shard A
+after exchange E with shard B before it.  The fault matrix mirrors the
+single-server restart tests per shard: killing one shard re-seeds only
+that shard's leaf range on rejoin while its siblings run uninterrupted.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel.server import ASGDServer, EASGDServer
+from theanompi_tpu.parallel.service import ServiceClient
+from theanompi_tpu.parallel.shards import (
+    ShardParamService,
+    ShardedASGD,
+    ShardedEASGD,
+    partition_ranges,
+    serve_shard,
+    shard_addresses,
+)
+from theanompi_tpu.utils.helper_funcs import build_optimizer
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _start_shard(port: int, index: int):
+    ready, stop = threading.Event(), threading.Event()
+    t = threading.Thread(target=serve_shard,
+                         args=("127.0.0.1", port, index, ready, stop),
+                         daemon=True)
+    t.start()
+    assert ready.wait(10)
+    return t, stop
+
+
+def _start_fleet(k: int):
+    fleet = []
+    for i in range(k):
+        port = _free_port()
+        t, stop = _start_shard(port, i)
+        fleet.append({"addr": f"127.0.0.1:{port}", "port": port,
+                      "thread": t, "stop": stop})
+    return fleet
+
+
+def _stop_fleet(fleet):
+    for s in fleet:
+        s["stop"].set()
+        try:
+            ServiceClient(s["addr"]).call("shutdown")
+        except Exception:
+            pass
+        s["thread"].join(timeout=5)
+
+
+@pytest.fixture()
+def shard_env(monkeypatch):
+    monkeypatch.setenv("THEANOMPI_TPU_SERVICE_KEY", "shards-test")
+    monkeypatch.setenv("THEANOMPI_TPU_SERVICE_RETRIES", "6")
+    monkeypatch.setenv("THEANOMPI_TPU_SERVICE_RETRY_DEADLINE_S", "20")
+
+
+def _tree(seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    return {"a": rng.standard_normal((8, 4)).astype(np.float32),
+            "b": rng.standard_normal((33,)).astype(np.float32),
+            "c": {"d": rng.standard_normal((4, 4)).astype(np.float32),
+                  "e": rng.standard_normal((9,)).astype(np.float32)},
+            "f": rng.standard_normal((2, 2, 2)).astype(np.float32)}
+
+
+def _assert_bytes_equal(a, b, msg=""):
+    fa, ta = jax.tree.flatten(a)
+    fb, tb = jax.tree.flatten(b)
+    assert ta == tb, f"treedef mismatch {msg}"
+    for x, y in zip(fa, fb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and x.shape == y.shape, msg
+        assert x.tobytes() == y.tobytes(), msg
+
+
+# ---------------------------------------------------------------------------
+# Leaf-range partitioning
+# ---------------------------------------------------------------------------
+
+
+class TestPartition:
+    def test_contiguous_covering_deterministic(self):
+        rng = np.random.default_rng(7)
+        sizes = [int(s) for s in rng.integers(1, 10_000, size=37)]
+        for k in (1, 2, 3, 5, 11, 37):
+            r1 = partition_ranges(sizes, k)
+            r2 = partition_ranges(list(sizes), k)
+            assert r1 == r2  # pure function of (sizes, k)
+            assert len(r1) == k
+            assert r1[0][0] == 0 and r1[-1][1] == len(sizes)
+            for (a, b), (c, d) in zip(r1, r1[1:]):
+                assert b == c      # contiguous
+            assert all(hi > lo for lo, hi in r1)  # never empty
+
+    def test_byte_balance(self):
+        # many same-sized leaves must split near-evenly
+        sizes = [1000] * 64
+        for k in (2, 4, 8):
+            r = partition_ranges(sizes, k)
+            loads = [sum(sizes[lo:hi]) for lo, hi in r]
+            assert max(loads) <= 2 * min(loads)
+
+    def test_zero_size_leaves_ok(self):
+        r = partition_ranges([0, 10, 0, 10], 2)
+        assert r[0][0] == 0 and r[-1][1] == 4
+
+    def test_more_shards_than_leaves_refused(self):
+        with pytest.raises(ValueError, match="at most one shard"):
+            partition_ranges([1, 2], 3)
+        with pytest.raises(ValueError, match="empty tree"):
+            partition_ranges([], 1)
+
+    def test_addr_parsing(self):
+        assert shard_addresses(None) is None
+        assert shard_addresses("h:1") == ["h:1"]
+        assert shard_addresses("h:1, g:2,") == ["h:1", "g:2"]
+
+
+# ---------------------------------------------------------------------------
+# Equivalence pins: K shards byte-identical to the single center
+# ---------------------------------------------------------------------------
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_easgd_byte_identical_every_exchange(self, shard_env, k):
+        """Acceptance pin: a fixed-seed exchange sequence against K=2
+        and K=4 shards reassembles byte-identically to the K=1
+        single-center run at EVERY exchange, and the fenced center
+        matches too."""
+        tree = _tree(0)
+        oracle = EASGDServer(tree, alpha=0.5)
+        fleet = _start_fleet(k)
+        try:
+            srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                               alpha=0.5, session_id=f"eq-{k}")
+            for n in range(1, 6):
+                w = jax.tree.map(
+                    lambda x: x + np.float32(0.07 * n), tree)
+                out = srv.exchange(w)
+                exp = jax.tree.map(np.asarray,
+                                   jax.device_get(oracle.exchange(w)))
+                _assert_bytes_equal(out, exp, f"exchange {n} (K={k})")
+            center, vclock = srv.fenced_center()
+            _assert_bytes_equal(
+                center,
+                jax.tree.map(np.asarray,
+                             jax.device_get(oracle.get_center())),
+                f"center (K={k})")
+            assert vclock == {srv._client_id: 5}
+            assert srv.n_exchanges == 5
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_asgd_byte_identical_with_lr_schedule(self, shard_env):
+        """Per-shard optimizers (SGD + momentum + weight decay, with a
+        mid-run set_lr) reassemble byte-identically: every optax
+        transform the builder emits is per-leaf, and leaves are never
+        split."""
+        tree = _tree(1)
+        opt_cfg = dict(learning_rate=0.1, optimizer="sgd", momentum=0.9,
+                       nesterov=False, weight_decay=1e-4)
+        oracle = ASGDServer(tree, build_optimizer(**opt_cfg))
+        fleet = _start_fleet(2)
+        try:
+            srv = ShardedASGD([s["addr"] for s in fleet], tree, opt_cfg,
+                              session_id="asgd-eq")
+            for n in range(1, 4):
+                g = jax.tree.map(
+                    lambda x: np.full_like(x, 0.01 * n,
+                                           dtype=np.float32), tree)
+                out = srv.push_pull(g)
+                exp = jax.tree.map(np.asarray,
+                                   jax.device_get(oracle.push_pull(g)))
+                _assert_bytes_equal(out, exp, f"push {n}")
+            srv.set_lr(0.02)
+            oracle.set_lr(0.02)
+            g = jax.tree.map(
+                lambda x: np.ones_like(x, dtype=np.float32), tree)
+            _assert_bytes_equal(
+                srv.push_pull(g),
+                jax.tree.map(np.asarray,
+                             jax.device_get(oracle.push_pull(g))),
+                "push after set_lr")
+            assert srv.n_updates == 4
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_sharded_asgd_opt_state_contract(self, shard_env):
+        """The documented optimizer-state trade: a restored opt_state
+        is refused at init (no scatter), and get_opt_state is refused
+        (no single-tree reassembly) — docs/RESILIENCE.md."""
+        tree = _tree(2)
+        fleet = _start_fleet(2)
+        try:
+            with pytest.raises(ValueError, match="opt_state"):
+                ShardedASGD([s["addr"] for s in fleet], tree,
+                            {"learning_rate": 0.1},
+                            opt_state={"bogus": np.zeros(1)})
+            srv = ShardedASGD([s["addr"] for s in fleet], tree,
+                              {"learning_rate": 0.1}, session_id="oc")
+            assert srv.supports_opt_state is False
+            with pytest.raises(RuntimeError, match="opt_state"):
+                srv.get_opt_state()
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+
+# ---------------------------------------------------------------------------
+# Wire parity: restored trees byte-exact per shard, both protocols
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol", ["v1", "v2"])
+def test_restored_tree_byte_exact_per_shard(shard_env, monkeypatch,
+                                            protocol):
+    """test_service.py's restored-tree pin, per shard: the mixed-dtype
+    tree survives the partition + per-shard wire + fence reassembly
+    byte-exactly under BOTH protocols, and every shard connection
+    negotiated the protocol asked for."""
+    monkeypatch.setenv("THEANOMPI_TPU_WIRE_PROTOCOL", protocol)
+    tree = {"f32": np.arange(12, dtype=np.float32).reshape(3, 4) * 0.37,
+            "f64": np.linspace(0.0, 1.0, 7),
+            "i32": np.arange(-5, 5, dtype=np.int32),
+            "u8": np.arange(64, dtype=np.uint8).reshape(8, 8),
+            "empty": np.zeros((0, 3), np.float32),
+            "nested": [np.full((2, 2), 9.5, np.float16),
+                       {"deep": np.array([True, False])}]}
+    fleet = _start_fleet(2)
+    try:
+        srv = ShardedEASGD([s["addr"] for s in fleet], tree, alpha=0.5,
+                           session_id=f"bytes-{protocol}")
+        for c in srv._shard_clients:
+            assert c.wire_protocol == protocol
+        assert srv.wire_protocol == protocol
+        _assert_bytes_equal(srv.get_center(), tree, protocol)
+        srv.close()
+    finally:
+        _stop_fleet(fleet)
+
+
+# ---------------------------------------------------------------------------
+# The cross-shard version fence
+# ---------------------------------------------------------------------------
+
+
+class TestVersionFence:
+    def test_atomic_cut_under_concurrent_exchanges(self, shard_env):
+        """THE atomicity pin: fenced reads taken while a worker
+        exchanges concurrently always equal the oracle center at
+        exactly the version the fence's vector clock names — never a
+        mix of shard states from different exchanges."""
+        tree = _tree(3)
+        N = 30
+
+        def w_at(n):
+            return jax.tree.map(lambda x: x + np.float32(0.05 * n),
+                                tree)
+
+        oracle = EASGDServer(tree, alpha=0.5)
+        centers = [jax.tree.map(np.asarray,
+                                jax.device_get(oracle.get_center()))]
+        for n in range(1, N + 1):
+            oracle.exchange(w_at(n))
+            centers.append(jax.tree.map(
+                np.asarray, jax.device_get(oracle.get_center())))
+
+        fleet = _start_fleet(2)
+        try:
+            srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                               alpha=0.5, session_id="fence")
+            errs: list[BaseException] = []
+
+            def mutate():
+                try:
+                    for n in range(1, N + 1):
+                        srv.exchange(w_at(n))
+                        time.sleep(0.002)
+                except BaseException as e:  # surfaced below
+                    errs.append(e)
+
+            mt = threading.Thread(target=mutate)
+            mt.start()
+            reads = 0
+            try:
+                while mt.is_alive():
+                    cut, vclock = srv.fenced_center()
+                    n = vclock.get(srv._client_id, 0)
+                    _assert_bytes_equal(cut, centers[n],
+                                        f"torn cut at version {n}")
+                    reads += 1
+            finally:
+                mt.join(timeout=30)
+            assert not errs, errs
+            assert reads >= 1
+            # quiescent read lands on the final version exactly
+            cut, vclock = srv.fenced_center()
+            assert vclock == {srv._client_id: N}
+            _assert_bytes_equal(cut, centers[N], "final")
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_concurrent_readers_fence_busy_retries(self, shard_env):
+        """Two readers fencing the same fleet (orchestrator +
+        supervisor restart, say) both succeed — FenceBusy is retried,
+        not surfaced."""
+        tree = _tree(4)
+        fleet = _start_fleet(2)
+        try:
+            srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                               alpha=0.5, session_id="busy")
+            results: list = []
+            errs: list[BaseException] = []
+
+            def read_loop():
+                try:
+                    for _ in range(5):
+                        results.append(srv.fenced_center())
+                except BaseException as e:
+                    errs.append(e)
+
+            readers = [threading.Thread(target=read_loop)
+                       for _ in range(2)]
+            for t in readers:
+                t.start()
+            for t in readers:
+                t.join(timeout=30)
+            assert not errs, errs
+            assert len(results) == 10
+            for cut, _ in results:
+                _assert_bytes_equal(cut, tree, "unmutated center")
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_stale_fence_auto_expires(self, shard_env, monkeypatch):
+        """A reader that froze a shard and died must not wedge
+        training: past THEANOMPI_TPU_SHARD_FENCE_TIMEOUT_S the shard
+        auto-releases and blocked exchanges proceed."""
+        monkeypatch.setenv("THEANOMPI_TPU_SHARD_FENCE_TIMEOUT_S", "0.5")
+        tree = _tree(5)
+        fleet = _start_fleet(2)
+        try:
+            srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                               alpha=0.5, session_id="stale")
+            ghost = ServiceClient(fleet[0]["addr"])
+            ghost.call("shard_freeze", "easgd", "stale", "ghost-token")
+            # no release: the ghost reader is gone
+            t0 = time.monotonic()
+            out = srv.exchange(jax.tree.map(
+                lambda x: x + np.float32(1.0), tree))
+            assert time.monotonic() - t0 < 10
+            assert all(np.isfinite(np.asarray(x)).all()
+                       for x in jax.tree.leaves(out))
+            # release with a stranger's token is a silent no-op
+            ghost.call("shard_release", "easgd", "stale", "wrong-token")
+            ghost.close()
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_stable_divergence_accepted(self, shard_env):
+        """Liveness under dead history (code-review finding): a client
+        that died mid-scatter leaves its tag on SOME shards forever —
+        exact clock equality is then permanently unreachable, but the
+        fence must still produce cuts (3 stable frozen observations
+        prove no straddler is pending) instead of failing every
+        checkpoint until max_attempts."""
+        tree = _tree(7)
+        fleet = _start_fleet(2)
+        try:
+            srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                               alpha=0.5, session_id="diverge")
+            srv.exchange(jax.tree.map(
+                lambda x: x + np.float32(0.5), tree))
+            # a "dead" client's partial op: one tagged sub-exchange on
+            # shard 0 only, never completed on shard 1
+            lo, hi = srv._plan.ranges[0]
+            flat = [np.asarray(x) for x in
+                    jax.tree.leaves(jax.tree.map(
+                        lambda x: x + np.float32(2.0), tree))]
+            ghost = srv._shard_clients[0]
+            ghost.call("shard_exchange", "diverge", flat[lo:hi],
+                       "dead-client", 1)
+            cut, vclock = srv.fenced_center()
+            # the union-max clock names both writers
+            assert vclock[srv._client_id] == 1
+            assert vclock["dead-client"] == 1
+            assert all(np.isfinite(np.asarray(x)).all()
+                       for x in jax.tree.leaves(cut))
+            # and live traffic afterwards still fences fine
+            srv.exchange(jax.tree.map(
+                lambda x: x + np.float32(1.0), tree))
+            cut2, vclock2 = srv.fenced_center()
+            assert vclock2[srv._client_id] == 2
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_freeze_unit_semantics(self):
+        """In-process ShardParamService: admission blocks while
+        frozen, the vector clock versions successful mutations only,
+        and FenceBusy/ShardNotReady ride the typed-error channel."""
+        from theanompi_tpu.parallel.service import (
+            FenceBusy,
+            ShardNotReady,
+        )
+
+        svc = ShardParamService(3)
+        with pytest.raises(ShardNotReady):
+            svc.handle("shard_freeze", "easgd", "s", "t0")
+        svc.handle("easgd_init", {"w": np.zeros(4, np.float32)}, 0.5,
+                   "s")
+        info = svc.handle("shard_freeze", "easgd", "s", "t1")
+        assert info == {"shard": 3, "vclock": {}, "applied": 0}
+        with pytest.raises(FenceBusy):
+            svc.handle("shard_freeze", "easgd", "s", "t2")
+        admitted = threading.Event()
+
+        def mutate():
+            svc.handle("shard_exchange", "s",
+                       {"w": np.ones(4, np.float32)}, "c", 1)
+            admitted.set()
+
+        t = threading.Thread(target=mutate, daemon=True)
+        t.start()
+        assert not admitted.wait(0.3)  # frozen: mutation parked
+        svc.handle("shard_release", "easgd", "s", "t1")
+        assert admitted.wait(5)
+        t.join(5)
+        info = svc.handle("shard_freeze", "easgd", "s", "t3")
+        assert info["vclock"] == {"c": 1} and info["applied"] == 1
+        svc.handle("shard_release", "easgd", "s", "t3")
+        # a non-int seq is refused BEFORE the store op (an applied-but-
+        # unversioned mutation would be invisible to the fence)
+        with pytest.raises(ValueError, match="seq"):
+            svc.handle("shard_exchange", "s",
+                       {"w": np.ones(4, np.float32)}, "c", "bogus")
+        # an at-least-once DUPLICATE (same client, same seq — a lost-
+        # reply re-send) bumps the applied counter though the vclock is
+        # unchanged: the counter is what lets post-read validation see
+        # a duplicate that slipped through an expired fence
+        svc.handle("shard_exchange", "s",
+                   {"w": np.ones(4, np.float32)}, "c", 1)
+        info = svc.handle("shard_freeze", "easgd", "s", "t4")
+        assert info["vclock"] == {"c": 1} and info["applied"] == 2
+        svc.handle("shard_release", "easgd", "s", "t4")
+
+    def test_wait_ready_detects_wrong_shard(self, shard_env):
+        """A stale process squatting on a shard's port (answering as a
+        different shard index) must fail the fleet startup loudly —
+        not be retried into a misleading 'never came up' timeout, and
+        never be accepted (code-review finding: this was a bare
+        assert, stripped under python -O)."""
+        from theanompi_tpu.analysis.lockgraph import make_lock
+        from theanompi_tpu.parallel.shards import ShardProcessGroup
+
+        port = _free_port()
+        t, stop = _start_shard(port, 5)  # wrong index on purpose
+        try:
+            g = ShardProcessGroup.__new__(ShardProcessGroup)
+            g.host = "127.0.0.1"
+            g._ports = [port]
+            g._lock = make_lock("test-group-lock")
+            g._stopping = threading.Event()
+            g.max_restarts = 0
+
+            class _FakeProc:
+                returncode = None
+
+                def poll(self):
+                    return None
+
+                def terminate(self):
+                    pass
+
+                def wait(self, timeout=None):
+                    return 0
+
+            g._procs = [_FakeProc()]
+            g._restarts = {}
+            with pytest.raises(RuntimeError,
+                               match="answered as shard 5"):
+                g._wait_ready(10.0)
+        finally:
+            stop.set()
+            try:
+                ServiceClient(f"127.0.0.1:{port}").call("shutdown")
+            except Exception:
+                pass
+            t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Shard fault matrix
+# ---------------------------------------------------------------------------
+
+
+class TestShardFaultMatrix:
+    def test_single_shard_kill_and_rejoin(self, shard_env):
+        """Kill + restart ONE shard mid-run: the sibling shard's store
+        is untouched (its exchange count runs uninterrupted), and the
+        rejoin re-seeds ONLY the dead shard's leaf range — from the
+        client's last good sub-result, the per-shard mirror of the
+        single-server restart matrix."""
+        tree = _tree(6)
+        N = 5
+
+        def w_at(n):
+            return jax.tree.map(lambda x: x + np.float32(0.1 * n),
+                                tree)
+
+        fleet = _start_fleet(2)
+        try:
+            srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                               alpha=0.5, session_id="kill")
+            last = None
+            for n in range(1, N + 1):
+                last = srv.exchange(w_at(n))
+
+            # hard restart of shard 1 only (same port, fresh store)
+            s1 = fleet[1]
+            s1["stop"].set()
+            try:
+                ServiceClient(s1["addr"]).call("shutdown")
+            except Exception:
+                pass
+            s1["thread"].join(timeout=5)
+            s1["thread"], s1["stop"] = _start_shard(s1["port"], 1)
+
+            out = srv.exchange(w_at(N + 1))
+            st0 = srv._shard_clients[0].call("stats")
+            st1 = srv._shard_clients[1].call("stats")
+            # sibling uninterrupted; dead shard rebuilt fresh
+            assert st0["n_exchanges"] == N + 1
+            assert st1["n_exchanges"] == 1
+
+            # shard 0's range: center evolved normally.  shard 1's
+            # range: center re-seeded from the client's LAST GOOD
+            # sub-result, so new_w = w - a*(w - last)
+            flat_out = [np.asarray(x) for x in jax.tree.leaves(out)]
+            flat_w = [np.asarray(x) for x in jax.tree.leaves(w_at(N + 1))]
+            flat_last = [np.asarray(x) for x in jax.tree.leaves(last)]
+            lo, hi = srv._plan.ranges[1]
+            for j in range(lo, hi):
+                exp = (flat_w[j]
+                       - np.float32(0.5) * (flat_w[j] - flat_last[j]))
+                np.testing.assert_array_equal(
+                    flat_out[j], exp.astype(np.float32),
+                    err_msg=f"shard-1 leaf {j} rejoin math")
+            # the fence works across the rebuilt shard too
+            cut, vclock = srv.fenced_center()
+            assert vclock == {srv._client_id: N + 1}
+            assert all(np.isfinite(np.asarray(x)).all()
+                       for x in jax.tree.leaves(cut))
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_gosgd_refuses_sharded_hub(self, shard_env, tmp_path):
+        """The gossip hub stays unsharded: a comma-separated
+        server_addr is a configuration error, surfaced immediately."""
+        from theanompi_tpu import GOSGD
+        from theanompi_tpu.models.base import ModelConfig
+
+        rule = GOSGD()
+        rule.init(devices=1, modelfile="tests._tiny_models",
+                  modelclass="TinyCifar",
+                  config=ModelConfig(batch_size=8, n_epochs=1,
+                                     snapshot_dir=str(tmp_path),
+                                     print_freq=0),
+                  checkpoint=False,
+                  server_addr="127.0.0.1:1,127.0.0.1:2")
+        with pytest.raises(ValueError, match="unsharded"):
+            rule.wait()
+
+
+# ---------------------------------------------------------------------------
+# Launcher flag validation (no processes spawned — all fail fast)
+# ---------------------------------------------------------------------------
+
+
+class TestLauncherShardFlag:
+    @pytest.mark.parametrize("argv,match", [
+        (["GOSGD", "-m", "cifar10", "--shards", "2"], "EASGD/ASGD"),
+        (["BSP", "-m", "cifar10", "--shards", "2"], "EASGD/ASGD"),
+        (["EASGD", "-m", "cifar10", "--shards", "2",
+          "--server-addr", "h:1"], "not both"),
+        (["EASGD", "-m", "cifar10", "--shards", "0"], ">= 1"),
+    ])
+    def test_invalid_combinations_exit(self, argv, match):
+        from theanompi_tpu.launcher import tmlocal
+
+        with pytest.raises(SystemExit, match=match):
+            tmlocal(argv)
+
+
+# ---------------------------------------------------------------------------
+# Rules end-to-end over a sharded center
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(tmp_path, **kw):
+    from theanompi_tpu.models.base import ModelConfig
+
+    base = dict(batch_size=8, n_epochs=1, learning_rate=0.01,
+                snapshot_dir=str(tmp_path), print_freq=0)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def test_easgd_rule_with_sharded_center(shard_env, tmp_path):
+    """EASGD end-to-end against 2 real shard sockets: workers exchange
+    leaf ranges concurrently, the orchestrator's per-epoch validation
+    reads the center through the version fence while workers keep
+    exchanging — the whole wiring under real concurrency."""
+    from theanompi_tpu import EASGD
+
+    fleet = _start_fleet(2)
+    try:
+        rule = EASGD()
+        rule.init(devices=2, modelfile="tests._tiny_models",
+                  modelclass="TinyCifar",
+                  config=_tiny_cfg(tmp_path), tau=4, alpha=0.5,
+                  checkpoint=False,
+                  server_addr=",".join(s["addr"] for s in fleet))
+        res = rule.wait()
+        assert res["n_exchanges"] > 0
+        assert np.isfinite(res["val"]["loss"])
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in jax.tree.leaves(res["center"]))
+    finally:
+        _stop_fleet(fleet)
+
+
+def test_asgd_rule_sharded_checkpoint_resume(shard_env, tmp_path):
+    """ASGD over shards, with checkpointing: the per-epoch save goes
+    through the fenced center read and the worker-side opt_state
+    fallback (ShardedASGD.supports_opt_state), and a resumed session
+    re-seeds the center exactly with fresh server momentum — the
+    documented sharded-resume trade."""
+    from theanompi_tpu import ASGD
+
+    fleet = _start_fleet(2)
+    try:
+        addr = ",".join(s["addr"] for s in fleet)
+        rule = ASGD()
+        rule.init(devices=2, modelfile="tests._tiny_models",
+                  modelclass="TinyCifar",
+                  config=_tiny_cfg(tmp_path), checkpoint=True,
+                  server_addr=addr)
+        res1 = rule.wait()
+        assert res1["n_updates"] > 0
+
+        rule2 = ASGD()
+        rule2.init(devices=2, modelfile="tests._tiny_models",
+                   modelclass="TinyCifar",
+                   config=_tiny_cfg(tmp_path, n_epochs=2),
+                   checkpoint=True, resume=True, server_addr=addr)
+        res2 = rule2.wait()
+        assert np.isfinite(res2["val"]["loss"])
+    finally:
+        _stop_fleet(fleet)

@@ -85,7 +85,21 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
     p.add_argument("--server-addr", default=None,
                    help="host:port of a tmserver parameter service — runs "
                         "the async rule's server over DCN instead of "
-                        "in-process (parallel/service.py)")
+                        "in-process (parallel/service.py).  A "
+                        "comma-separated list names a SHARD FLEET: the "
+                        "center is leaf-range-partitioned across the "
+                        "listed shard services (parallel/shards.py; "
+                        "EASGD/ASGD only)")
+    p.add_argument("--shards", type=int, default=None, metavar="K",
+                   help="EASGD/ASGD, single-host: spawn and supervise K "
+                        "shard service processes and partition the "
+                        "center across them (docs/DESIGN.md 'Sharded "
+                        "parameter service').  A crashed shard is "
+                        "relaunched (budget --max-restarts, default 1) "
+                        "and the workers' per-shard session rejoin "
+                        "re-seeds only its leaf range.  Multi-host runs "
+                        "point every host at one fleet via a "
+                        "comma-separated --server-addr instead")
     p.add_argument("--overlap-exchange", action="store_true",
                    help="EASGD/ASGD: run each worker's parameter "
                         "exchange on a dedicated thread so compute "
@@ -365,6 +379,34 @@ def _run(args, multihost: bool) -> int:
         # — silently ignoring the flag would let the user believe the
         # exchange is overlapped when it is not
         raise SystemExit("--overlap-exchange applies to EASGD/ASGD only")
+    shard_group = None
+    if args.shards is not None:
+        if args.rule not in ("EASGD", "ASGD"):
+            raise SystemExit(
+                "--shards applies to EASGD/ASGD only: the GOSGD gossip "
+                "hub is unsharded (it rendezvouses whole param trees, "
+                "not an accumulating center) and BSP has no parameter "
+                "service (docs/DESIGN.md 'Sharded parameter service')")
+        if multihost:
+            raise SystemExit(
+                "--shards is single-host (tmlocal spawns the shard "
+                "processes); multi-host runs start the fleet once and "
+                "point every host at it with a comma-separated "
+                "--server-addr")
+        if args.server_addr:
+            raise SystemExit(
+                "pass either --shards K (spawn a local shard fleet) or "
+                "a comma-separated --server-addr (an existing fleet), "
+                "not both")
+        if args.shards < 1:
+            raise SystemExit("--shards must be >= 1")
+        from theanompi_tpu.parallel.shards import ShardProcessGroup
+
+        shard_group = ShardProcessGroup(
+            args.shards,
+            max_restarts=(1 if args.max_restarts is None
+                          else args.max_restarts))
+        args.server_addr = shard_group.server_addr
     if args.rule == "EASGD":
         kwargs.update(tau=args.tau, alpha=args.alpha)
     elif args.rule == "GOSGD":
@@ -394,40 +436,44 @@ def _run(args, multihost: bool) -> int:
     session_restarts = (0 if multihost
                         else (args.max_restarts or 0))
     attempts = 0
-    while True:
-        rule.init(**kwargs)
-        try:
-            result = rule.wait()
-            break
-        except Exception as e:
-            attempts += 1
-            if attempts > session_restarts:
-                raise
-            import sys as _sys
+    try:
+        while True:
+            rule.init(**kwargs)
+            try:
+                result = rule.wait()
+                break
+            except Exception as e:
+                attempts += 1
+                if attempts > session_restarts:
+                    raise
+                import sys as _sys
 
-            if (args.rule == "GOSGD" and args.server_addr
-                    and args.session_id):
-                # a pinned-session-id gossip hub survives the crash
-                # WITH its deactivated ranks and stale in-flight
-                # payloads — resuming into it would refuse gossip to
-                # restarted ranks and merge pre-crash params; the
-                # operator must restart every host with a fresh id
-                print("[resilience] NOT auto-resuming GOSGD: the "
-                      f"pinned --session-id {args.session_id!r} hub "
-                      "keeps deactivated ranks and stale in-flight "
-                      "gossip across a resume; restart all hosts "
-                      "with a fresh --session-id", file=_sys.stderr,
-                      flush=True)
-                raise
-            print(f"[resilience] {args.rule} session died "
-                  f"({type(e).__name__}: {e}); auto-resume "
-                  f"{attempts}/{session_restarts} from the latest "
-                  "verified checkpoint", file=_sys.stderr, flush=True)
-            from theanompi_tpu import monitor
+                if (args.rule == "GOSGD" and args.server_addr
+                        and args.session_id):
+                    # a pinned-session-id gossip hub survives the crash
+                    # WITH its deactivated ranks and stale in-flight
+                    # payloads — resuming into it would refuse gossip to
+                    # restarted ranks and merge pre-crash params; the
+                    # operator must restart every host with a fresh id
+                    print("[resilience] NOT auto-resuming GOSGD: the "
+                          f"pinned --session-id {args.session_id!r} hub "
+                          "keeps deactivated ranks and stale in-flight "
+                          "gossip across a resume; restart all hosts "
+                          "with a fresh --session-id", file=_sys.stderr,
+                          flush=True)
+                    raise
+                print(f"[resilience] {args.rule} session died "
+                      f"({type(e).__name__}: {e}); auto-resume "
+                      f"{attempts}/{session_restarts} from the latest "
+                      "verified checkpoint", file=_sys.stderr, flush=True)
+                from theanompi_tpu import monitor
 
-            monitor.inc("resilience/session_autoresumes_total")
-            kwargs.update(resume=True)
-            rule = rule_cls()
+                monitor.inc("resilience/session_autoresumes_total")
+                kwargs.update(resume=True)
+                rule = rule_cls()
+    finally:
+        if shard_group is not None:
+            shard_group.stop()
     val = result.get("val", {})
     if val:
         print("final val:", {k: round(float(v), 4) for k, v in val.items()})

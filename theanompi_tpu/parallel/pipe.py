@@ -1,0 +1,141 @@
+"""One-in-flight exchange thread — the shared comm/compute overlap
+primitive.
+
+Extracted from ``rules/async_rules.py`` (ISSUE 8) so the sharded
+parameter-service router (``parallel/shards.py``) can reuse the same
+thread discipline for its per-shard sub-exchanges without importing
+the rules layer: the async rules overlap ONE exchange behind compute,
+the shard router runs K per-shard sub-calls concurrently — both are
+"hand a payload to a dedicated thread, collect exactly once, errors
+re-raise at the collect site".
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
+
+#: _ExchangePipe shutdown sentinel
+_STOP = object()
+
+
+class _ExchangePipe:
+    """One in-flight parameter exchange per worker — the comm/compute
+    overlap plane (ISSUE 5 tentpole; the reference hid its MPI
+    exchanges behind compute the same way, with a dedicated exchanger
+    stream per worker).
+
+    ``submit(payload)`` hands a HOST-side payload to this worker's
+    exchange thread and returns immediately; the worker keeps
+    computing while the RPC (serialize + wire + server merge) runs.
+    ``collect()`` blocks until the in-flight exchange finishes and
+    returns ``(payload, result)``.  The barrier is bounded-staleness:
+    at most ONE exchange outstanding (``submit`` while outstanding
+    raises), so a worker can never run ahead of the center by more
+    than one exchange period.
+
+    Fault-site-aware: the exchange function runs the SAME client call
+    path as the synchronous mode, so an injected ``service_call``
+    fault (resilience.faults) still lands — its exception is carried
+    to the worker and re-raised at ``collect()``/``submit()``, where
+    the supervisor's restart semantics see it exactly like a
+    synchronous failure.
+
+    Telemetry: each RPC runs under a top-level span in the exchange
+    thread (``<name>_rpc`` by default; the shard router passes
+    ``span='shard_exchange'``); the worker's wait inside ``collect``
+    is its own ``<name>_collect`` span — the monitor can therefore
+    PROVE overlap (compute spans no longer enclose the RPC span;
+    collect time << rpc time), asserted by
+    tests/test_async_overlap.py."""
+
+    def __init__(self, fn, name: str, worker: int, span: str | None = None):
+        self._fn = fn
+        self._name = name
+        self._span = span if span is not None else f"{name}_rpc"
+        self._worker = str(worker)
+        self._req: queue.Queue = queue.Queue(maxsize=1)
+        self._res: queue.Queue = queue.Queue(maxsize=1)
+        self._lock = make_lock("_ExchangePipe._lock")
+        self._err: BaseException | None = None  # guarded_by: self._lock
+        self.outstanding = False                # guarded_by: self._lock
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"{name}-exchange-w{worker}")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._req.get()
+            if item is _STOP:
+                return
+            try:
+                with monitor.span(self._span, worker=self._worker):
+                    out = (self._fn(item), None)
+            except BaseException as e:  # surfaced at collect()
+                out = (None, e)
+            self._res.put((item, out))
+
+    def busy(self) -> bool:
+        """Locked read of the barrier flag — the worker loop's drain
+        checks go through here so every access of the guarded state
+        honors the declared discipline."""
+        with self._lock:
+            return self.outstanding
+
+    def submit(self, payload) -> None:
+        """Hand one host payload to the exchange thread (returns
+        immediately).  A prior failure or an already-outstanding
+        exchange raises here."""
+        # the barrier flag and the sticky error are declared
+        # guarded_by this lock: today a pipe is owned by exactly one
+        # worker thread, so the lock buys visibility/discipline rather
+        # than fixing a live race — but it keeps check-then-set atomic
+        # if the ownership story ever changes, at nanoseconds of cost
+        with self._lock:
+            if self._err is not None:
+                raise self._err
+            if self.outstanding:
+                raise RuntimeError(
+                    f"{self._name}: bounded-staleness barrier — at most "
+                    "one exchange may be outstanding; collect() first")
+            self.outstanding = True
+        try:
+            # queue put outside the lock: it can block when the
+            # exchange thread still holds the previous item
+            self._req.put(payload)
+        except BaseException:
+            with self._lock:
+                self.outstanding = False
+            raise
+
+    def collect(self):
+        """Block for the in-flight exchange; returns (payload, result).
+        Re-raises the exchange thread's exception (incl. injected
+        faults) in the worker thread."""
+        payload, (result, err) = self._res.get()
+        with self._lock:
+            self.outstanding = False
+            if err is not None:
+                self._err = err
+        if err is not None:
+            raise err
+        return payload, result
+
+    def close(self) -> None:
+        """Stop the exchange thread (idempotent; never blocks on an
+        uncollected result — the queues hold at most one item each)."""
+        try:
+            self._req.put_nowait(_STOP)
+        except queue.Full:
+            # a request is still queued: a dropped sentinel would leave
+            # the exchange thread parked on _req.get() forever (pinning
+            # the client + model closures across supervisor restarts) —
+            # a reaper delivers STOP once the thread dequeues the
+            # request, without blocking the worker here
+            threading.Thread(target=self._req.put, args=(_STOP,),
+                             daemon=True,
+                             name=f"{self._name}-exchange-reaper").start()

@@ -13,7 +13,11 @@ GossipHub); stores are created lazily by the first ``*_init`` request,
 so the service needs no model code or rule flag at launch.  Clients
 mirror the stores' duck-type APIs, so a rule session is pointed at a
 remote server by a single ``server_addr=`` argument — the in-process
-store remains the fast local path.
+store remains the fast local path.  When one service process becomes
+the ceiling, ``parallel/shards.py`` partitions the center across K of
+them (``server_addr`` becomes a comma-separated fleet; see
+:class:`ShardedServiceClient` and docs/DESIGN.md "Sharded parameter
+service").
 
 Transport: ``multiprocessing.connection`` (stdlib) with HMAC
 challenge/response auth, speaking one of two protocols negotiated per
@@ -57,6 +61,7 @@ import argparse
 import os
 import threading
 import time
+import uuid
 from multiprocessing.connection import Client, Connection, Listener
 from typing import Any
 
@@ -64,6 +69,7 @@ import jax
 import numpy as np
 
 from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
 from theanompi_tpu.parallel import wire
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
@@ -297,7 +303,8 @@ class ParamService:
 def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
           ready_event: threading.Event | None = None,
           stop_event: threading.Event | None = None,
-          authkey: bytes | None = None) -> None:
+          authkey: bytes | None = None,
+          service: ParamService | None = None) -> None:
     """Run the service until a ``shutdown`` op (or ``stop_event``).
     One handler thread per connection; each worker thread keeps its own
     persistent connection, so worker exchanges proceed concurrently up
@@ -308,8 +315,13 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
     when unset (the export is how a same-process client or spawned
     worker inherits it).  Pass ``authkey`` explicitly to avoid the env
     mutation, e.g. when embedding a service thread in a worker that also
-    talks to OTHER services under different keys."""
-    service = ParamService()
+    talks to OTHER services under different keys.
+
+    ``service`` overrides the dispatcher — ``parallel/shards.py`` runs
+    this same loop over a :class:`ShardParamService` (version-fenced
+    shard of a partitioned center)."""
+    if service is None:
+        service = ParamService()
     if stop_event is None:
         stop_event = threading.Event()  # so the shutdown op works
     if authkey is None:
@@ -317,6 +329,12 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
     listener = Listener((host, port), authkey=authkey)
     if ready_event is not None:
         ready_event.set()
+    # live established connections, closed when the serve loop exits:
+    # an embedded (thread-hosted) service restart must look like a
+    # process restart to its clients — handler threads parked in recv
+    # on a dead service's store would otherwise keep answering
+    conns: set[Connection] = set()
+    conns_lock = threading.Lock()
 
     def handle_conn(conn: Connection):
         # connected-client gauge: one handler thread per connection, so
@@ -359,117 +377,155 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
                     return False
 
         try:
-            with conn:
-                while True:
-                    if wire_opts is None:
-                        try:
-                            msg = conn.recv()
-                        except (EOFError, OSError):
-                            return
-                        except Exception as e:
-                            # corrupt/unpicklable v1 request: surface a
-                            # typed diagnostic instead of silently
-                            # killing the handler thread
-                            monitor.inc("service/errors_total",
-                                        op="malformed")
-                            if not reply(("err",
-                                          f"{type(e).__name__}: {e}")):
-                                return
-                            continue
-                    else:
-                        try:
-                            msg = wire.recv_msg(conn, wire_opts)
-                        except wire.WireDecodeError as e:
-                            # typed decode failure, never a hang: the
-                            # peer gets a diagnostic; the connection
-                            # survives when the frame was drained
-                            # (stream still aligned), closes otherwise
-                            monitor.inc("service/errors_total",
-                                        op="wire_decode")
-                            ok = reply(("err",
-                                        f"{type(e).__name__}: {e}"))
-                            if not ok or not getattr(
-                                    e, "frame_drained", False):
-                                return
-                            continue
-                        except (EOFError, OSError):
-                            return
-                    if not isinstance(msg, tuple) or not msg:
-                        monitor.inc("service/errors_total", op="malformed")
-                        if not reply(("err", "malformed request")):
-                            return
-                        continue
-                    op, *args = msg
-                    if op == wire.HELLO_OP:
-                        # version negotiation: confirm v2 + options on
-                        # the CURRENT protocol, then switch framing (a
-                        # legacy server would answer "unknown op" and
-                        # the client stays on v1)
-                        try:
-                            negotiated, hello_reply = wire.accept_hello(
-                                args[0] if args else None)
-                        except wire.WireProtocolError as e:
-                            if not reply(("err",
-                                          f"{type(e).__name__}: {e}")):
-                                return
-                            continue
-                        if not reply(("ok", hello_reply)):
-                            return
-                        wire_opts = negotiated
-                        monitor.inc("service/wire_negotiations_total",
-                                    compression=negotiated.compression,
-                                    dtype=negotiated.dtype)
-                        continue
-                    if op == "shutdown":
-                        reply(("ok", None))
-                        if stop_event is not None:
-                            stop_event.set()
-                        # unblock accept() so the serve loop exits
-                        try:
-                            Client((host if host != "0.0.0.0"
-                                    else "127.0.0.1",
-                                    port), authkey=authkey).close()
-                        except OSError:
-                            pass
-                        return
-                    t0 = time.monotonic()
+            while True:
+                if wire_opts is None:
                     try:
-                        result = service.handle(op, *args)
-                    except Exception as e:  # surfaced client-side
-                        monitor.inc("service/errors_total", op=op)
-                        if not reply(("err", f"{type(e).__name__}: {e}")):
+                        msg = conn.recv()
+                    except (EOFError, OSError):
+                        return
+                    except Exception as e:
+                        if isinstance(e, TypeError) and conn.closed:
+                            # the shutdown path closed this connection
+                            # out from under a blocked recv — the
+                            # stdlib reads from a None handle.  An
+                            # OPEN conn's TypeError is a corrupt
+                            # pickle (e.g. a hostile __reduce__) and
+                            # falls through to the diagnostic below
+                            return
+                        # corrupt/unpicklable v1 request: surface a
+                        # typed diagnostic instead of silently
+                        # killing the handler thread
+                        monitor.inc("service/errors_total",
+                                    op="malformed")
+                        if not reply(("err",
+                                      f"{type(e).__name__}: {e}")):
                             return
                         continue
-                    sent = reply(("ok", result), op=op)
-                    if not sent:
-                        return  # peer gone; nothing to tell it
-                    if sent is True:
-                        # a degraded (serialize-failed) reply was
-                        # already charged to errors_total under this
-                        # op — it must not also count as a success
-                        monitor.inc("service/requests_total", op=op)
-                        monitor.observe("service/rpc_ms",
-                                        (time.monotonic() - t0) * 1e3,
-                                        op=op)
-                    # served work IS this process's progress
-                    monitor.progress(phase="serving")
+                else:
+                    try:
+                        msg = wire.recv_msg(conn, wire_opts)
+                    except wire.WireDecodeError as e:
+                        # typed decode failure, never a hang: the
+                        # peer gets a diagnostic; the connection
+                        # survives when the frame was drained
+                        # (stream still aligned), closes otherwise
+                        monitor.inc("service/errors_total",
+                                    op="wire_decode")
+                        ok = reply(("err",
+                                    f"{type(e).__name__}: {e}"))
+                        if not ok or not getattr(
+                                e, "frame_drained", False):
+                            return
+                        continue
+                    except (EOFError, OSError):
+                        return
+                    except TypeError:
+                        if conn.closed:
+                            # shutdown closed the connection under a
+                            # blocked recv (None handle read)
+                            return
+                        raise  # a genuine bug — don't mask it
+                if not isinstance(msg, tuple) or not msg:
+                    monitor.inc("service/errors_total", op="malformed")
+                    if not reply(("err", "malformed request")):
+                        return
+                    continue
+                op, *args = msg
+                if op == wire.HELLO_OP:
+                    # version negotiation: confirm v2 + options on
+                    # the CURRENT protocol, then switch framing (a
+                    # legacy server would answer "unknown op" and
+                    # the client stays on v1)
+                    try:
+                        negotiated, hello_reply = wire.accept_hello(
+                            args[0] if args else None)
+                    except wire.WireProtocolError as e:
+                        if not reply(("err",
+                                      f"{type(e).__name__}: {e}")):
+                            return
+                        continue
+                    if not reply(("ok", hello_reply)):
+                        return
+                    wire_opts = negotiated
+                    monitor.inc("service/wire_negotiations_total",
+                                compression=negotiated.compression,
+                                dtype=negotiated.dtype)
+                    continue
+                if op == "shutdown":
+                    reply(("ok", None))
+                    if stop_event is not None:
+                        stop_event.set()
+                    # unblock accept() so the serve loop exits
+                    try:
+                        Client((host if host != "0.0.0.0"
+                                else "127.0.0.1",
+                                port), authkey=authkey).close()
+                    except OSError:
+                        pass
+                    return
+                t0 = time.monotonic()
+                try:
+                    result = service.handle(op, *args)
+                except Exception as e:  # surfaced client-side
+                    monitor.inc("service/errors_total", op=op)
+                    if not reply(("err", f"{type(e).__name__}: {e}")):
+                        return
+                    continue
+                sent = reply(("ok", result), op=op)
+                if not sent:
+                    return  # peer gone; nothing to tell it
+                if sent is True:
+                    # a degraded (serialize-failed) reply was
+                    # already charged to errors_total under this
+                    # op — it must not also count as a success
+                    monitor.inc("service/requests_total", op=op)
+                    monitor.observe("service/rpc_ms",
+                                    (time.monotonic() - t0) * 1e3,
+                                    op=op)
+                # served work IS this process's progress
+                monitor.progress(phase="serving")
         finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with conns_lock:
+                conns.discard(conn)
             monitor.add_gauge("service/clients", -1.0)
 
     from multiprocessing import AuthenticationError
 
-    with listener:
-        while stop_event is None or not stop_event.is_set():
+    try:
+        with listener:
+            while stop_event is None or not stop_event.is_set():
+                try:
+                    conn = listener.accept()
+                except AuthenticationError:
+                    continue  # a bad-key peer must not kill the service
+                except OSError:
+                    if stop_event is not None and stop_event.is_set():
+                        return
+                    raise
+                # register BEFORE the handler thread starts: a conn
+                # accepted just as shutdown lands must still be in the
+                # close sweep, or its handler would keep serving the
+                # retired service object
+                with conns_lock:
+                    conns.add(conn)
+                threading.Thread(target=handle_conn, args=(conn,),
+                                 daemon=True).start()
+    finally:
+        # faithful shutdown: drop established connections so an
+        # embedded service restart looks like a process restart (the
+        # blocked recv in each handler raises and the thread exits;
+        # clients enter their reconnect/rejoin path)
+        with conns_lock:
+            live = list(conns)
+        for c in live:
             try:
-                conn = listener.accept()
-            except AuthenticationError:
-                continue  # a bad-key peer must not kill the service
+                c.close()
             except OSError:
-                if stop_event is not None and stop_event.is_set():
-                    return
-                raise
-            threading.Thread(target=handle_conn, args=(conn,),
-                             daemon=True).start()
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -500,6 +556,22 @@ class SessionDisplaced(RuntimeError):
     class name rides the wire in the err reply (the service prefixes
     every error with ``type(e).__name__``), giving the client a typed
     marker to classify on instead of prose."""
+
+
+class FenceBusy(RuntimeError):
+    """A ``shard_freeze`` refused because another reader's fence holds
+    the shard (``parallel/shards.py``).  Like :class:`SessionDisplaced`
+    the class name rides the wire in the err reply, so the fence loop
+    can classify it as retryable without matching prose."""
+
+
+class ShardNotReady(RuntimeError):
+    """A ``shard_freeze`` hit a shard whose session store is not (yet)
+    live — typically the freeze raced a shard restart, before any
+    worker's rejoin has rebuilt that shard's leaf range.  Retryable
+    (the fence loop backs off while a payload-bearing worker rebuilds
+    the store); a genuinely dead session exhausts the fence's bounded
+    attempts instead of failing on the first race."""
 
 
 #: sentinel: "no reply received yet" in ServiceClient.call's retry loop
@@ -725,6 +797,334 @@ class ServiceClient:
             self._conn.close()  # lint: ok TM101
         except OSError:
             pass
+
+
+class ShardedServiceClient:
+    """Client-side shard router (ISSUE 8, docs/DESIGN.md "Sharded
+    parameter service"): K per-shard session clients — each its own
+    authenticated connection, :class:`RetryPolicy`, and rejoin state,
+    so a single shard's restart is recovered exactly like the tested
+    single-server restart matrix, re-seeding ONLY that shard's leaf
+    range — plus the concurrency plumbing the subclasses
+    (``parallel/shards.py`` ShardedEASGD / ShardedASGD, which own the
+    tree partitioning) build on:
+
+    * :meth:`_scatter` issues one sub-call per shard on dedicated
+      exchange threads (``parallel/pipe.py`` — the same thread
+      discipline the async rules' overlap plane uses) and collects ALL
+      K results before re-raising the first failure, so a dead shard
+      can never leave a sibling's sub-exchange dangling on the pipes'
+      bounded-staleness barrier;
+    * :meth:`fenced_read` is the cross-shard version fence — the
+      two-phase consistent cut checkpoint/export reads through:
+      **freeze** every shard (each blocks new exchanges and drains its
+      in-flight one, returning its per-client vector clock), compare
+      the clocks, and only **read + release** when they all agree.  A
+      mismatch means some worker's full-tree exchange straddled the
+      freeze (applied on one shard, still pending on another); the
+      fence releases everything, backs off, and retries, so a
+      checkpoint can never capture shard A after exchange E and shard
+      B before it.
+
+    Mutating sub-calls carry a ``(client_id, seq)`` tag — one ``seq``
+    per FULL-tree operation, shared by all K sub-calls — which is what
+    makes the vector clocks comparable across shards.  Delivery
+    semantics are unchanged from the single-center client: elastic
+    exchanges and grad pushes stay at-least-once across transport
+    failures (a re-sent duplicate re-applies, exactly as documented
+    for :class:`ServiceClient`), and the vector clock's per-client max
+    keeps a duplicate from reading as a new exchange."""
+
+    def __init__(self, shard_clients: list, kind: str, session_id: str):
+        if not shard_clients:
+            raise ValueError("need at least one shard client")
+        self._shard_clients = list(shard_clients)
+        self._kind = kind
+        self._sid = str(session_id)
+        #: tags this router's mutations in every shard's vector clock
+        self._client_id = uuid.uuid4().hex
+        self._router_lock = make_lock("ShardedServiceClient._router_lock")
+        self._seq = 0        # guarded_by: self._router_lock
+        self._pipes = None   # guarded_by: self._router_lock
+        # the fence runs over its OWN control connections: a mutation
+        # blocked by the freeze parks its connection's server handler
+        # thread in fence admission, so freeze/read/release sharing
+        # that connection would queue BEHIND the very exchange the
+        # fence is holding back — head-of-line deadlock until the
+        # fence auto-expires, and a read that then observes post-
+        # freeze state (caught by the test suite's torn-cut pin)
+        self._fence_clients: list[ServiceClient | None] = \
+            [None] * len(shard_clients)  # guarded_by: self._router_lock
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._shard_clients)
+
+    @property
+    def wire_protocol(self) -> str:
+        """Negotiated protocol (shards negotiate independently but
+        from one env/default, so shard 0 speaks for the fleet)."""
+        return self._shard_clients[0].wire_protocol
+
+    # -- concurrent scatter/gather ------------------------------------
+
+    def _next_seq(self) -> int:
+        with self._router_lock:
+            self._seq += 1
+            return self._seq
+
+    def _ensure_pipes(self) -> list:
+        from theanompi_tpu.parallel.pipe import _ExchangePipe
+
+        with self._router_lock:
+            if self._pipes is None:
+                # lazily: a client used only for fenced reads (the
+                # EASGD orchestrator) never spins exchange threads
+                self._pipes = [
+                    _ExchangePipe(lambda thunk: thunk(), "shard", i,
+                                  span="shard_exchange")
+                    for i in range(len(self._shard_clients))]
+            return self._pipes
+
+    def _reset_pipes(self) -> None:
+        """Drop the exchange threads after a scatter failure: the
+        pipes' sticky-error discipline is right for a worker loop (the
+        supervisor rebuilds the whole client) but this router object
+        may outlive the failure (the rule's creator handle does), so
+        the next scatter gets fresh pipes instead of a poisoned
+        barrier."""
+        with self._router_lock:
+            pipes, self._pipes = self._pipes, None
+        for p in pipes or ():
+            p.close()
+
+    def _scatter(self, thunks: list):
+        """Run one thunk per shard concurrently (each on its shard's
+        exchange thread); returns results in shard order.  Collects
+        every in-flight sub-call before re-raising the first failure."""
+        pipes = self._ensure_pipes()
+        for pipe, thunk in zip(pipes, thunks):
+            pipe.submit(thunk)
+        outs: list = []
+        first_err: BaseException | None = None
+        for pipe in pipes:
+            try:
+                _, out = pipe.collect()
+                outs.append(out)
+            except BaseException as e:
+                outs.append(None)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            self._reset_pipes()
+            raise first_err
+        return outs
+
+    # -- the cross-shard version fence --------------------------------
+
+    def _fence_client(self, i: int) -> "ServiceClient":
+        """The shard's dedicated control connection (lazy — a client
+        that never fences opens no extra sockets)."""
+        with self._router_lock:
+            c = self._fence_clients[i]
+        if c is None:
+            host, port = self._shard_clients[i].address
+            c = ServiceClient(f"{host}:{port}")
+            with self._router_lock:
+                if self._fence_clients[i] is None:
+                    self._fence_clients[i] = c
+                else:  # lost a benign race; keep the first
+                    c.close()
+                    c = self._fence_clients[i]
+        return c
+
+    def fenced_read(self, read_op: str, max_attempts: int = 100):
+        """Two-phase consistent cut over ``read_op`` (see
+        :meth:`fenced_op`)."""
+        return self.fenced_op(read_op, max_attempts=max_attempts)
+
+    def fenced_op(self, op: str, *args, max_attempts: int = 100):
+        """Two-phase consistent cut (class docstring): freeze all →
+        compare vector clocks → run ``op`` on every shard →
+        RE-VALIDATE → release, retrying on a straddling exchange, a
+        concurrent reader's fence, or a shard mid-restart.  Returns
+        ``(per-shard results in shard order, the cut's vector
+        clock)``.
+
+        ``op`` may also be a fleet-wide WRITE that must not interleave
+        with any client's K-way scatter (ShardedASGD's ``set_lr``: a
+        mid-broadcast push would apply with the old lr on some leaf
+        ranges and the new lr on others — the single-center store
+        serializes the two under one lock, and the fence is that
+        lock's distributed form).  Such an op must be idempotent: a
+        failed validation re-runs it on the next attempt.
+
+        Two hardening rules beyond the happy path:
+
+        * **Post-read validation.**  A fence the reader held too long
+          auto-expires server-side (a dead reader must not wedge
+          training), which could let a mutation slip onto a shard read
+          later in the loop — a torn cut presented as consistent.  So
+          after the reads, every shard is re-frozen with the SAME
+          token and BOTH its vector clock and its applied-mutation
+          counter compared to the pre-read ones; any drift discards
+          the attempt.  The counter matters because the clock alone is
+          blind to an at-least-once DUPLICATE re-apply (recorded as
+          per-client max seq) slipping through an expired fence.  A
+          cut is returned only when no mutation landed anywhere
+          between first freeze and validation.
+        * **Stable-divergence acceptance.**  Exact clock equality can
+          become permanently unreachable: a client that died mid-
+          scatter leaves its (client, seq) on some shards forever, and
+          a restarted shard loses entries for clients that never
+          exchange again.  A PENDING straddler applies within the
+          release window between attempts (admission is notified on
+          release), so clocks that stay bitwise-identical across 3
+          consecutive frozen observations — with released windows
+          between — are dead history, not in-flight work: the cut is
+          accepted (``service/shard_fence_divergence_total``) with the
+          per-client max clock.  The frozen state itself is still
+          validated mutation-free; what is lost is only the claim that
+          the dead client's partial op never happened — the system
+          state already includes it, permanently.
+        """
+        token = uuid.uuid4().hex
+        t0 = time.monotonic()
+        last: BaseException | None = None
+        n = self.n_shards
+        prev_clocks: list | None = None
+        stable = 0
+
+        def freeze(i: int):
+            return self._fence_client(i).call(
+                "shard_freeze", self._kind, self._sid, token)
+
+        for attempt in range(max_attempts):
+            if attempt:
+                # jittered to de-synchronize from a fixed exchange
+                # cadence; short because the straddler completes as
+                # soon as the release lands
+                time.sleep(min(0.25, 0.005 * (1 << min(attempt, 5)))
+                           * (0.5 + (hash((token, attempt)) % 100) / 100))
+            err, infos = self._fanout(freeze)
+            if err is not None:
+                self._release(token)
+                if self._fence_retryable(err):
+                    last = err  # another reader's fence, a shard mid-
+                    continue    # restart, or a connect refused while
+                                # the process group relaunches it
+                raise err
+            clocks = [info["vclock"] for info in infos]
+            applied = [info.get("applied") for info in infos]
+            consistent = all(vc == clocks[0] for vc in clocks)
+            if not consistent:
+                stable = stable + 1 if clocks == prev_clocks else 0
+                prev_clocks = clocks
+                if stable < 2:
+                    self._release(token)
+                    monitor.inc("service/shard_fence_retries_total")
+                    continue
+                monitor.inc("service/shard_fence_divergence_total")
+            try:
+                op_err, outs = self._fanout(
+                    lambda i: self._fence_client(i).call(op, self._sid,
+                                                         *args))
+                if op_err is None:
+                    # post-op validation: re-freeze with the same
+                    # token; drifted clocks OR applied counters mean an
+                    # expired fence let a mutation (possibly a
+                    # clock-invisible duplicate) through mid-op —
+                    # discard the torn cut
+                    op_err, post = self._fanout(freeze)
+            finally:
+                self._release(token)
+            if op_err is not None:
+                if self._fence_retryable(op_err):
+                    last = op_err
+                    continue
+                raise op_err
+            if ([p["vclock"] for p in post] != clocks
+                    or [p.get("applied") for p in post] != applied):
+                prev_clocks, stable = None, 0  # live mutator: not dead
+                monitor.inc("service/shard_fence_retries_total")
+                last = RuntimeError("fence expired mid-operation")
+                continue
+            monitor.observe("service/shard_fence_ms",
+                            (time.monotonic() - t0) * 1e3)
+            if consistent:
+                return outs, clocks[0]
+            merged: dict = {}
+            for vc in clocks:
+                for cid, seq in vc.items():
+                    merged[cid] = max(seq, merged.get(cid, 0))
+            return outs, merged
+        raise RuntimeError(
+            f"no consistent cut across {n} shards after "
+            f"{max_attempts} freeze attempts "
+            f"({time.monotonic() - t0:.1f}s): {last}")
+
+    @staticmethod
+    def _fence_retryable(e: BaseException) -> bool:
+        """Fence-loop errors worth another attempt: another reader's
+        fence, a shard whose store is mid-rejoin, or a transport
+        failure (incl. a connect refused while the process group is
+        relaunching the shard — ServiceClient construction has no
+        retry of its own)."""
+        if isinstance(e, ServiceError):
+            return (FenceBusy.__name__ in str(e)
+                    or ShardNotReady.__name__ in str(e))
+        return isinstance(e, CONNECTION_ERRORS)
+
+    def _fanout(self, fn) -> tuple[BaseException | None, list]:
+        """Run ``fn(i)`` for every shard concurrently; returns (first
+        error or None, per-shard results).  Used for the freeze /
+        read / validate sweeps so the fence-hold time — during which
+        every shard's mutations are parked — is ONE shard's latency,
+        not the sum, and so a worker's K-way scatter has the smallest
+        possible window to straddle the freeze."""
+        n = self.n_shards
+        outs: list = [None] * n
+        errs: list = [None] * n
+
+        def run(i: int) -> None:
+            try:
+                outs[i] = fn(i)
+            except BaseException as e:
+                errs[i] = e
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True,
+                                    name=f"shard-fence-{i}")
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return next((e for e in errs if e is not None), None), outs
+
+    def _release(self, token: str) -> None:
+        """Best-effort concurrent release of every shard: releasing a
+        token a shard never froze is a server-side no-op, and an
+        unreachable shard auto-expires its fence (ShardParamService
+        fence timeout)."""
+        def rel(i: int):
+            try:
+                return self._fence_client(i).call(
+                    "shard_release", self._kind, self._sid, token)
+            except Exception:
+                return None
+
+        self._fanout(rel)
+
+    def close(self) -> None:
+        self._reset_pipes()
+        with self._router_lock:
+            fence, self._fence_clients = (list(self._fence_clients),
+                                          [None] * self.n_shards)
+        for c in fence:
+            if c is not None:
+                c.close()
+        for c in self._shard_clients:
+            c.close()
 
 
 class RemoteEASGD(ServiceClient):

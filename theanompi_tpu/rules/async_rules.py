@@ -42,10 +42,7 @@ from typing import Any
 import jax
 import numpy as np
 
-import queue
-
 from theanompi_tpu import monitor
-from theanompi_tpu.analysis.lockgraph import make_lock
 from theanompi_tpu.models.base import TpuModel
 from theanompi_tpu.parallel.exchanger import (
     easgd_apply_delta,
@@ -59,6 +56,12 @@ from theanompi_tpu.parallel.service import (
     RemoteEASGD,
     RemoteGossipHub,
     ServiceClient,
+    ShardedServiceClient,
+)
+from theanompi_tpu.parallel.shards import (
+    ShardedASGD,
+    ShardedEASGD,
+    shard_addresses,
 )
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.supervisor import WorkerSupervisor
@@ -87,125 +90,9 @@ def _prune_gosgd_sidecars(sidecar_dir: str, kept: set[int]) -> None:
                 pass
 
 
-#: _ExchangePipe shutdown sentinel
-_STOP = object()
-
-
-class _ExchangePipe:
-    """One in-flight parameter exchange per worker — the comm/compute
-    overlap plane (ISSUE 5 tentpole; the reference hid its MPI
-    exchanges behind compute the same way, with a dedicated exchanger
-    stream per worker).
-
-    ``submit(payload)`` hands a HOST-side payload to this worker's
-    exchange thread and returns immediately; the worker keeps
-    computing while the RPC (serialize + wire + server merge) runs.
-    ``collect()`` blocks until the in-flight exchange finishes and
-    returns ``(payload, result)``.  The barrier is bounded-staleness:
-    at most ONE exchange outstanding (``submit`` while outstanding
-    raises), so a worker can never run ahead of the center by more
-    than one exchange period.
-
-    Fault-site-aware: the exchange function runs the SAME client call
-    path as the synchronous mode, so an injected ``service_call``
-    fault (resilience.faults) still lands — its exception is carried
-    to the worker and re-raised at ``collect()``/``submit()``, where
-    the supervisor's restart semantics see it exactly like a
-    synchronous failure.
-
-    Telemetry: each RPC runs under a top-level ``<name>_rpc`` span in
-    the exchange thread; the worker's wait inside ``collect`` is its
-    own ``<name>_collect`` span — the monitor can therefore PROVE
-    overlap (compute spans no longer enclose the RPC span; collect
-    time << rpc time), asserted by tests/test_async_overlap.py."""
-
-    def __init__(self, fn, name: str, worker: int):
-        self._fn = fn
-        self._name = name
-        self._worker = str(worker)
-        self._req: queue.Queue = queue.Queue(maxsize=1)
-        self._res: queue.Queue = queue.Queue(maxsize=1)
-        self._lock = make_lock("_ExchangePipe._lock")
-        self._err: BaseException | None = None  # guarded_by: self._lock
-        self.outstanding = False                # guarded_by: self._lock
-        self._thread = threading.Thread(
-            target=self._run, daemon=True,
-            name=f"{name}-exchange-w{worker}")
-        self._thread.start()
-
-    def _run(self):
-        while True:
-            item = self._req.get()
-            if item is _STOP:
-                return
-            try:
-                with monitor.span(f"{self._name}_rpc",
-                                  worker=self._worker):
-                    out = (self._fn(item), None)
-            except BaseException as e:  # surfaced at collect()
-                out = (None, e)
-            self._res.put((item, out))
-
-    def busy(self) -> bool:
-        """Locked read of the barrier flag — the worker loop's drain
-        checks go through here so every access of the guarded state
-        honors the declared discipline."""
-        with self._lock:
-            return self.outstanding
-
-    def submit(self, payload) -> None:
-        """Hand one host payload to the exchange thread (returns
-        immediately).  A prior failure or an already-outstanding
-        exchange raises here."""
-        # the barrier flag and the sticky error are declared
-        # guarded_by this lock: today a pipe is owned by exactly one
-        # worker thread, so the lock buys visibility/discipline rather
-        # than fixing a live race — but it keeps check-then-set atomic
-        # if the ownership story ever changes, at nanoseconds of cost
-        with self._lock:
-            if self._err is not None:
-                raise self._err
-            if self.outstanding:
-                raise RuntimeError(
-                    f"{self._name}: bounded-staleness barrier — at most "
-                    "one exchange may be outstanding; collect() first")
-            self.outstanding = True
-        try:
-            # queue put outside the lock: it can block when the
-            # exchange thread still holds the previous item
-            self._req.put(payload)
-        except BaseException:
-            with self._lock:
-                self.outstanding = False
-            raise
-
-    def collect(self):
-        """Block for the in-flight exchange; returns (payload, result).
-        Re-raises the exchange thread's exception (incl. injected
-        faults) in the worker thread."""
-        payload, (result, err) = self._res.get()
-        with self._lock:
-            self.outstanding = False
-            if err is not None:
-                self._err = err
-        if err is not None:
-            raise err
-        return payload, result
-
-    def close(self) -> None:
-        """Stop the exchange thread (idempotent; never blocks on an
-        uncollected result — the queues hold at most one item each)."""
-        try:
-            self._req.put_nowait(_STOP)
-        except queue.Full:
-            # a request is still queued: a dropped sentinel would leave
-            # the exchange thread parked on _req.get() forever (pinning
-            # the client + model closures across supervisor restarts) —
-            # a reaper delivers STOP once the thread dequeues the
-            # request, without blocking the worker here
-            threading.Thread(target=self._req.put, args=(_STOP,),
-                             daemon=True,
-                             name=f"{self._name}-exchange-reaper").start()
+# _ExchangePipe moved to parallel/pipe.py (ISSUE 8: the shard router
+# reuses it); re-exported here so existing importers keep working.
+from theanompi_tpu.parallel.pipe import _STOP, _ExchangePipe  # noqa: F401,E402
 
 
 class _AsyncRule(Rule):
@@ -306,6 +193,13 @@ class EASGD(_AsyncRule):
                     m.state = m.state.replace(
                         params=replicate(center0, m.mesh))
                     m.adjust_hyperp(start_epoch)
+        # a comma-separated server_addr is a SHARD FLEET: the center is
+        # leaf-range-partitioned across the listed shard services
+        # (parallel/shards.py, docs/DESIGN.md "Sharded parameter
+        # service"); a single address keeps the one-center client
+        addrs = shard_addresses(server_addr)
+        sharded = addrs is not None and len(addrs) > 1
+
         def connect():
             """Each worker thread gets its OWN connection (the service
             handles connections concurrently; one shared client would
@@ -317,16 +211,22 @@ class EASGD(_AsyncRule):
             if server_addr:
                 # DCN path: the center lives in a separate service
                 # process (possibly another machine) — parallel/service
-                return RemoteEASGD(server_addr, None, alpha=alpha,
+                if sharded:
+                    return ShardedEASGD(addrs, None, alpha=alpha,
+                                        session_id=session_id)
+                return RemoteEASGD(addrs[0], None, alpha=alpha,
                                    session_id=session_id)
             return server
 
         if server_addr:
             # session creator: ship the initial center from the MAIN
             # thread, before any worker's train step can donate it
-            server = RemoteEASGD(server_addr,
-                                 jax.device_get(models[0].state.params),
-                                 alpha=alpha, session_id=session_id)
+            init_params = jax.device_get(models[0].state.params)
+            server = (ShardedEASGD(addrs, init_params, alpha=alpha,
+                                   session_id=session_id)
+                      if sharded else
+                      RemoteEASGD(addrs[0], init_params,
+                                  alpha=alpha, session_id=session_id))
         else:
             server = EASGDServer(models[0].state.params, alpha=alpha)
         self.server = server
@@ -446,7 +346,8 @@ class EASGD(_AsyncRule):
                     if pipe is not None:
                         pipe.close()
                     model.cleanup()
-                    if srv is not server and isinstance(srv, ServiceClient):
+                    if srv is not server and isinstance(
+                            srv, (ServiceClient, ShardedServiceClient)):
                         srv.close()
 
             return work
@@ -506,7 +407,7 @@ class EASGD(_AsyncRule):
         finally:
             if ckpt is not None:
                 ckpt.close()
-            if isinstance(server, ServiceClient):
+            if isinstance(server, (ServiceClient, ShardedServiceClient)):
                 server.close()
 
 
@@ -548,21 +449,43 @@ class ASGD(_AsyncRule):
                         params=replicate(center0, m.mesh))
                     m.adjust_hyperp(start_epoch)
 
+        # shard-fleet server_addr (see EASGD._session): the center AND
+        # its per-range optimizer states live across the listed shards
+        addrs = shard_addresses(server_addr)
+        sharded = addrs is not None and len(addrs) > 1
+        if sharded and restored_opt is not None:
+            # per-shard optax states do not reassemble/scatter (each
+            # shard holds its own hyperparam/count leaves): resume
+            # re-seeds the center EXACTLY and restarts server momentum
+            # fresh — the same documented trade the service-restart
+            # rejoin makes (docs/RESILIENCE.md)
+            print("[asgd] sharded resume: center restored exactly; "
+                  "server optimizer momentum restarts fresh "
+                  "(docs/RESILIENCE.md)", flush=True)
+            restored_opt = None
+
         def connect():
             """Own connection per worker thread; workers join without a
             payload (see EASGD.connect on the donation race + waste)."""
             if server_addr:
-                return RemoteASGD(server_addr, None,
+                if sharded:
+                    return ShardedASGD(addrs, None,
+                                       models[0].optimizer_hyperparams(),
+                                       session_id=session_id)
+                return RemoteASGD(addrs[0], None,
                                   models[0].optimizer_hyperparams(),
                                   session_id=session_id)
             return server
 
         if server_addr:
-            server = RemoteASGD(server_addr,
-                                jax.device_get(models[0].state.params),
-                                models[0].optimizer_hyperparams(),
-                                opt_state=restored_opt,
-                                session_id=session_id)
+            init_params = jax.device_get(models[0].state.params)
+            opt_cfg = models[0].optimizer_hyperparams()
+            server = (ShardedASGD(addrs, init_params, opt_cfg,
+                                  session_id=session_id)
+                      if sharded else
+                      RemoteASGD(addrs[0], init_params, opt_cfg,
+                                 opt_state=restored_opt,
+                                 session_id=session_id))
         else:
             server = ASGDServer(jax.device_get(models[0].state.params),
                                 models[0].tx)
@@ -690,12 +613,23 @@ class ASGD(_AsyncRule):
                                 # (the pre-crash checkpoint of that
                                 # epoch stands; force=True would
                                 # REFUSE, not overwrite, on orbax 0.7)
+                                # sharded servers have no single-tree
+                                # opt_state (ShardedASGD docstring):
+                                # keep the worker's own structure so
+                                # the checkpoint stays restorable —
+                                # resume re-seeds momentum fresh
+                                opt = (jax.device_get(
+                                           srv.get_opt_state())
+                                       if getattr(srv,
+                                                  "supports_opt_state",
+                                                  True)
+                                       else jax.device_get(
+                                           model.state.opt_state))
                                 ckpt.save(epoch, {
                                     "state": model.state.replace(
                                         params=jax.device_get(
                                             srv.get_center()),
-                                        opt_state=jax.device_get(
-                                            srv.get_opt_state()),
+                                        opt_state=opt,
                                     ),
                                     "epoch": epoch,
                                 })
@@ -709,7 +643,8 @@ class ASGD(_AsyncRule):
                     if pipe is not None:
                         pipe.close()
                     model.cleanup()
-                    if srv is not server and isinstance(srv, ServiceClient):
+                    if srv is not server and isinstance(
+                            srv, (ServiceClient, ShardedServiceClient)):
                         srv.close()
 
             return work
@@ -723,7 +658,7 @@ class ASGD(_AsyncRule):
         finally:
             if ckpt is not None:
                 ckpt.close()
-            if isinstance(server, ServiceClient):
+            if isinstance(server, (ServiceClient, ShardedServiceClient)):
                 server.close()
         probe = models[0]
         probe.compile_iter_fns("avg")
@@ -754,6 +689,14 @@ class GOSGD(_AsyncRule):
         if merge_momentum not in ("scale", "keep"):
             raise ValueError(f"merge_momentum must be 'scale' or 'keep', "
                              f"got {merge_momentum!r}")
+        addrs = shard_addresses(server_addr)
+        if addrs is not None and len(addrs) > 1:
+            raise ValueError(
+                "GOSGD's gossip hub is unsharded — it rendezvouses WHOLE "
+                "param trees, not an accumulating center, so there is "
+                "nothing to leaf-range-partition; pass a single "
+                "--server-addr (sharding applies to the EASGD/ASGD "
+                "center, docs/DESIGN.md 'Sharded parameter service')")
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
         self.model = models[0]
@@ -768,7 +711,7 @@ class GOSGD(_AsyncRule):
         def connect():
             """Own connection per worker thread (see EASGD.connect)."""
             if server_addr:
-                return RemoteGossipHub(server_addr, n_total,
+                return RemoteGossipHub(addrs[0], n_total,
                                        rank_offset=rank_offset,
                                        session_id=session_id)
             return hub

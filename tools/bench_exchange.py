@@ -166,6 +166,181 @@ def measure_mode(addr: str, protocol: str, compression: str, dtype: str,
     }
 
 
+def run_sharded(args) -> int:
+    """``--shards K`` mode (ISSUE 8): drive the same parameter tree
+    against K REAL shard processes via the shard router and compare
+    per-shard and aggregate bytes/wall against K=1.  The aggregate
+    exchange scatters K concurrent sub-exchanges (each shard process
+    serializes + merges its leaf range in parallel), so aggregate wall
+    should beat the single-center round trip on a multi-core box.
+
+    ``--smoke`` additionally kills shard 0 mid-run, waits for the
+    supervised relaunch, and asserts (a) both shards served traffic,
+    (b) the kill recovered (exchange succeeds, reconnect + restart
+    events land in the monitor JSONL) — the preflight 2-shard gate."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    os.environ.setdefault("THEANOMPI_TPU_SERVICE_KEY", "bench-exchange")
+    os.environ.setdefault(
+        "THEANOMPI_TPU_MONITOR",
+        os.path.join(REPO, "artifacts", "bench_exchange_monitor"))
+
+    from theanompi_tpu import monitor
+    from theanompi_tpu.parallel import wire
+    from theanompi_tpu.parallel.shards import (
+        ShardProcessGroup,
+        ShardedEASGD,
+    )
+
+    k = int(args.shards)
+    n_exchanges = max(3, args.exchanges)
+    tree = resnet50_like_tree(int(args.params))
+    n_params = tree_params(tree)
+    print(f"[bench_exchange] shard mode: {n_params/1e6:.1f}M params, "
+          f"{len(tree)} leaves, {tree_nbytes(tree)/1e6:.1f} MB f32, "
+          f"K in (1, {k})", flush=True)
+    opts = wire.WireOptions.from_env()
+
+    modes = []
+    kill_recovered = None
+    with monitor.session():
+        for n_shards in ([1, k] if k > 1 else [1]):
+            group = ShardProcessGroup(n_shards, max_restarts=2)
+            try:
+                sid = f"bench-shards-{n_shards}"
+                srv = ShardedEASGD(group.addresses, tree, alpha=0.5,
+                                   session_id=sid)
+                # exact per-shard wire bytes: encode the same frames
+                # the router's sub-exchanges send/receive
+                per_shard = []
+                flat, _ = jax.tree.flatten(tree)
+                for i, (lo, hi) in enumerate(srv._plan.ranges):
+                    sub = flat[lo:hi]
+                    _, _, st_req = wire.encode_frame(
+                        ("shard_exchange", sid, sub, "cid", 1), opts)
+                    _, _, st_rep = wire.encode_frame(("ok", sub), opts)
+                    per_shard.append({
+                        "shard": i, "n_leaves": hi - lo,
+                        "bytes_sent_per_exchange": st_req.post_bytes,
+                        "bytes_recv_per_exchange": st_rep.post_bytes,
+                    })
+                # probe round: each shard timed alone (sequential) for
+                # the per-shard wall; then the real concurrent rounds
+                seq = srv._next_seq()
+                for i, (lo, hi) in enumerate(srv._plan.ranges):
+                    t0 = time.monotonic()
+                    srv._shard_clients[i].exchange_tagged(
+                        flat[lo:hi], srv._client_id, seq)
+                    per_shard[i]["probe_wall_ms"] = round(
+                        (time.monotonic() - t0) * 1e3, 2)
+                walls = []
+                for _ in range(n_exchanges):
+                    t0 = time.monotonic()
+                    out = srv.exchange(tree)
+                    walls.append((time.monotonic() - t0) * 1e3)
+                assert np.isfinite(out[next(iter(tree))]).all()
+                if args.smoke and n_shards > 1:
+                    # fault leg: hard-kill shard 0, let the group
+                    # relaunch it, prove the router recovers (the
+                    # per-shard rejoin re-seeds only shard 0's range)
+                    group.kill_shard(0)
+                    group.wait_restarted(0)
+                    out = srv.exchange(tree)
+                    kill_recovered = bool(
+                        np.isfinite(out[next(iter(tree))]).all()
+                        and group.restart_counts().get(0) == 1)
+                    print(f"[bench_exchange] shard-0 kill recovered: "
+                          f"{kill_recovered}", flush=True)
+                srv.close()
+                modes.append({
+                    "shards": n_shards,
+                    "n_exchanges": n_exchanges,
+                    "wall_ms_mean": round(float(np.mean(walls)), 2),
+                    "wall_ms_min": round(float(np.min(walls)), 2),
+                    "bytes_per_exchange": sum(
+                        p["bytes_sent_per_exchange"]
+                        + p["bytes_recv_per_exchange"]
+                        for p in per_shard),
+                    "per_shard": per_shard,
+                })
+                print(f"[bench_exchange] K={n_shards}: "
+                      f"{modes[-1]['wall_ms_mean']:.0f} ms mean, "
+                      f"{modes[-1]['bytes_per_exchange']/1e6:.1f} "
+                      "MB/exchange", flush=True)
+            finally:
+                group.stop()
+        snapshot_path = monitor.flush()
+
+    k1 = next(m for m in modes if m["shards"] == 1)
+    kk = next(m for m in modes if m["shards"] == k)
+    improvement = 1.0 - kk["wall_ms_mean"] / k1["wall_ms_mean"]
+    out_doc = {
+        "bench": "shard_exchange",
+        "backend": "cpu",
+        "n_params": n_params,
+        "n_leaves": len(tree),
+        "tree_mb_f32": round(tree_nbytes(tree) / 1e6, 2),
+        "wire": {"compression": opts.compression, "dtype": opts.dtype},
+        "modes": modes,
+        "aggregate_wall_improvement_vs_k1": round(improvement, 4),
+        "kill_recovered": kill_recovered,
+    }
+    tag = args.tag or ("shard_smoke" if args.smoke else f"shard_k{k}")
+    path = args.out or os.path.join(REPO, "artifacts",
+                                    f"BENCH_{tag}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out_doc, f, indent=1)
+    print(f"[bench_exchange] wrote {path} (K={k} aggregate wall "
+          f"{improvement:+.1%} vs K=1)", flush=True)
+
+    if not args.smoke:
+        return 0
+    ok = True
+    if k < 2:
+        print("[bench_exchange] FAIL: shard smoke needs --shards >= 2",
+              file=sys.stderr)
+        ok = False
+    if improvement <= 0:
+        print(f"[bench_exchange] FAIL: K={k} aggregate wall "
+              f"({kk['wall_ms_mean']} ms) does not improve on K=1 "
+              f"({k1['wall_ms_mean']} ms)", file=sys.stderr)
+        ok = False
+    if kill_recovered is not True:
+        print("[bench_exchange] FAIL: shard-0 kill did not recover",
+              file=sys.stderr)
+        ok = False
+    # monitor JSONL: per-shard traffic (shard_exchange spans for every
+    # shard) + the recovery events (client reconnect, shard relaunch)
+    served, names = set(), set()
+    if snapshot_path and os.path.exists(snapshot_path):
+        with open(snapshot_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                names.add(rec.get("name"))
+                if (rec.get("name") == "span_ms"
+                        and rec.get("labels", {}).get("name")
+                        == "shard_exchange" and rec.get("count", 0) > 0):
+                    served.add(rec["labels"].get("worker"))
+    missing_shards = {str(i) for i in range(k)} - served
+    if missing_shards:
+        print(f"[bench_exchange] FAIL: no shard_exchange spans for "
+              f"shard(s) {sorted(missing_shards)} in the monitor JSONL "
+              f"({snapshot_path})", file=sys.stderr)
+        ok = False
+    for needed in ("service/client_reconnects_total",
+                   "service/shard_restarts_total"):
+        if needed not in names:
+            print(f"[bench_exchange] FAIL: {needed} missing from the "
+                  f"monitor JSONL ({snapshot_path})", file=sys.stderr)
+            ok = False
+    print(f"[bench_exchange] shard smoke {'PASS' if ok else 'FAIL'}",
+          flush=True)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--params", type=float, default=25.5e6,
@@ -177,11 +352,19 @@ def main(argv=None) -> int:
                          "BENCH_wire_<tag>.json)")
     ap.add_argument("--tag", default=None,
                     help="artifact tag (default: jax backend name)")
+    ap.add_argument("--shards", type=int, default=None, metavar="K",
+                    help="shard mode: drive the tree against K real "
+                         "shard processes (parallel/shards.py) and "
+                         "report per-shard + aggregate bytes/wall vs "
+                         "K=1; with --smoke also kills+recovers a "
+                         "shard (the preflight 2-shard gate)")
     ap.add_argument("--smoke", action="store_true",
                     help="preflight gate: 1 exchange/mode, assert the "
                          "v2 byte win + the monitor gauge, exit 1 on "
                          "failure")
     args = ap.parse_args(argv)
+    if args.shards is not None:
+        return run_sharded(args)
     if args.smoke:
         args.exchanges = 1
 

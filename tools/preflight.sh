@@ -208,7 +208,22 @@ PYEOF
   EXCHANGE_RC=$?
   rm -rf "$EXCHDIR"
   echo "exchange smoke rc=$EXCHANGE_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ]; then
+  echo "## shard smoke (2-shard EASGD over real sockets + kill-recovery, docs/DESIGN.md 'Sharded parameter service')"
+  # the sharded-center vertical end-to-end: two REAL shard processes,
+  # the router's concurrent leaf-range exchanges, and the fault leg —
+  # shard 0 is hard-killed, the process group relaunches it, and the
+  # per-shard session rejoin re-seeds only its leaf range.  The gate
+  # asserts the K=2 aggregate wall beats K=1, BOTH shards served
+  # traffic (per-shard shard_exchange spans in the monitor JSONL), and
+  # the recovery events (client reconnect + shard relaunch) landed
+  SHARDDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$SHARDDIR" \
+    python tools/bench_exchange.py --smoke --shards 2 \
+      --out "$SHARDDIR/BENCH_shard_smoke.json"
+  SHARD_RC=$?
+  rm -rf "$SHARDDIR"
+  echo "shard smoke rc=$SHARD_RC"
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
