@@ -62,7 +62,8 @@ def pytest_collection_modifyitems(config, items):
 #: daemonic (daemon= only means the INTERPRETER may exit; the suite
 #: keeps running)
 _REPO_THREAD_NAMES = ("-exchange-", "serving-batcher-",
-                      "serving-reload-watcher", "monitor-heartbeat-")
+                      "serving-reload-watcher", "monitor-heartbeat-",
+                      "ingest-")
 #: library pools that are non-daemon BY DESIGN and process-lived
 #: (concurrent.futures executors inside jax/orbax) — not leaks
 _POOL_THREAD_PREFIXES = ("ThreadPoolExecutor", "asyncio_", "grpc",

@@ -370,3 +370,72 @@ class TestNegotiation:
             wire.WireOptions(compression="lz4")
         with pytest.raises(ValueError):
             wire.WireOptions(dtype="f16")
+
+
+class TestRawArrays:
+    """The ingest uint8-batch frame op (ISSUE 9): RawArrays members
+    travel as raw zero-copy buffers no matter what the connection
+    negotiated — no zlib attempt, no bf16 re-dtype — and decode to a
+    plain tuple."""
+
+    def test_roundtrip_plain_tuple(self):
+        x = np.arange(2 * 4 * 4 * 3, dtype=np.uint8).reshape(2, 4, 4, 3)
+        y = np.array([3, 9], np.int32)
+        out, _ = roundtrip(("ok", wire.RawArrays(x, y)))
+        status, batch = out
+        assert status == "ok" and type(batch) is tuple
+        assert_tree_byte_equal(batch, (x, y))
+
+    def test_skips_negotiated_zlib(self):
+        # constant image: zlib WOULD shrink it massively, so surviving
+        # at raw size proves the compression attempt never ran
+        x = np.zeros((4, 16, 16, 3), np.uint8)
+        y = np.zeros(4, np.int32)
+        opts = wire.WireOptions(compression="zlib", dtype="bf16")
+        head, bufs, stats = wire.encode_frame(
+            wire.RawArrays(x, y), opts)
+        assert stats.post_bytes >= x.nbytes + y.nbytes
+        skel = json.loads(head[wire._HEADER.size:])
+        assert [n["comp"] for n in skel["v"]] == ["none", "none"]
+        assert all("wire" not in n for n in skel["v"])
+        out, _ = roundtrip(wire.RawArrays(x, y), opts)
+        assert_tree_byte_equal(out, (x, y))
+
+    def test_no_bf16_redtype_for_f32_member(self):
+        # an f32 leaf inside RawArrays must stay f32 on the wire even
+        # under a bf16-negotiated connection (bit-exactness contract)
+        f = np.linspace(0, 1, 7, dtype=np.float32)
+        out, stats = roundtrip(wire.RawArrays(f),
+                               wire.WireOptions(dtype="bf16"))
+        assert stats.post_bytes >= f.nbytes
+        assert_tree_byte_equal(out, (f,))
+
+    def test_rejects_non_arrays(self):
+        with pytest.raises(TypeError):
+            wire.RawArrays(np.zeros(2), "not-an-array")
+
+    def test_malformed_raw_node_is_typed(self):
+        head, bufs, _ = wire.encode_frame(
+            wire.RawArrays(np.zeros(2, np.uint8)), wire.WireOptions())
+        skel = json.loads(head[wire._HEADER.size:])
+        skel["v"][0] = {"t": "i", "v": 3}  # not an array node
+        new_skel = json.dumps(skel, separators=(",", ":")).encode()
+        new_head = wire._HEADER.pack(wire.MAGIC, wire.WIRE_VERSION, 0,
+                                     len(bufs), len(new_skel)) + new_skel
+        with pytest.raises(wire.WireDecodeError):
+            wire.decode_frame(new_head,
+                              [bytes(b) for b in bufs],
+                              wire.WireOptions())
+
+    def test_pickles_for_the_v1_path(self):
+        # a v1 (pickle) connection ships the whole reply through
+        # pickle; a RawArrays that cannot reconstruct would crash the
+        # trainer's first pull instead of delivering the batch
+        import pickle
+
+        x = np.arange(6, dtype=np.uint8).reshape(2, 3)
+        y = np.array([1, 2], np.int32)
+        out = pickle.loads(pickle.dumps(("ok", wire.RawArrays(x, y))))
+        status, batch = out
+        assert status == "ok"
+        assert_tree_byte_equal(tuple(batch), (x, y))

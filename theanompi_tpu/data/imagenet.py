@@ -159,6 +159,58 @@ def _shard_glob(data_dir: str, prefix: str) -> list[str]:
         + glob.glob(os.path.join(data_dir, f"{prefix}_*.x.npy")))
 
 
+# -- pure epoch-order derivation (shared with the ingest readers) -----------
+#
+# The reference broadcast each epoch's shuffled order from rank 0; here
+# the order is a pure function of (seed, epoch, rank, size), so the
+# in-process loader AND a standalone ingest reader fleet
+# (theanompi_tpu/ingest) derive the identical stream with zero
+# coordination — which is what makes the remote path byte-identical to
+# the local one (pinned by tests/test_ingest.py).  These three helpers
+# are THE single source of that derivation; ImageNet_data delegates.
+
+
+def epoch_file_order(files: Sequence[str], seed: int, epoch: int | None,
+                     rank: int = 0, size: int = 1) -> list[str]:
+    """The epoch's sharded file list: seeded permutation of the full
+    list (``epoch=None`` keeps sorted order — the val path), then this
+    rank's ``[rank::size]`` slice."""
+    files = list(files)
+    if epoch is not None:
+        order = np.random.default_rng(seed + 1000 + epoch)
+        files = [files[i] for i in order.permutation(len(files))]
+    if size > 1:
+        files = files[rank::size]
+    return files
+
+
+def shuffle_rng(seed: int, epoch: int, rank: int) -> np.random.Generator:
+    """The in-file shuffle stream: one per-file permutation is drawn
+    from it per shard file, in epoch file order."""
+    return np.random.default_rng(seed + 9000 + 7919 * epoch + rank)
+
+
+def augment_rng(seed: int, epoch: int, rank: int) -> np.random.Generator:
+    """The host-augmentation stream (unused — but still constructed —
+    when augmentation runs on device)."""
+    return np.random.default_rng(seed + 5000 + 7919 * epoch + rank)
+
+
+def shard_tree_signature(train_files: Sequence[str],
+                         sizes: dict[str, int], seed: int) -> dict:
+    """Identity of a (shard set, seed) pair — what trainer and ingest
+    reader must agree on for their streams to be byte-identical."""
+    import hashlib
+
+    sig = hashlib.sha256()
+    for f in train_files:
+        sig.update(f"{os.path.basename(f)}:{sizes[f]};".encode())
+    return {"seed": int(seed),
+            "n_train": int(sum(sizes[f] for f in train_files)),
+            "n_files": len(train_files),
+            "files_sha256": sig.hexdigest()}
+
+
 def _synthetic_pool(n_images: int, n_classes: int, hw: int, seed: int):
     """Pool of distinct patterned images (uint8) + labels.  Classes get
     distinct low-frequency signatures so models can actually fit them."""
@@ -292,13 +344,7 @@ class ImageNet_data(Dataset):
 
     def _sharded_files(self, files: list[str], epoch: int | None,
                        rank: int, size: int) -> list[str]:
-        files = list(files)
-        if epoch is not None:
-            order = np.random.default_rng(self.seed + 1000 + epoch)
-            files = [files[i] for i in order.permutation(len(files))]
-        if size > 1:
-            files = files[rank::size]
-        return files
+        return epoch_file_order(files, self.seed, epoch, rank, size)
 
     def _file_batches(self, files: list[str], global_batch: int,
                       aug_rng: np.random.Generator | None,
@@ -373,8 +419,8 @@ class ImageNet_data(Dataset):
             yield from self._synthetic_batches(n, global_batch, rng, True)
             return
         files = self._sharded_files(self.train_files, epoch, rank, size)
-        aug = np.random.default_rng(self.seed + 5000 + 7919 * epoch + rank)
-        shuf = np.random.default_rng(self.seed + 9000 + 7919 * epoch + rank)
+        aug = augment_rng(self.seed, epoch, rank)
+        shuf = shuffle_rng(self.seed, epoch, rank)
         yield from self._file_batches(files, global_batch, aug, shuf)
 
     def val_batches(self, global_batch: int,
@@ -397,6 +443,22 @@ class ImageNet_data(Dataset):
         files = self._sharded_files(self.train_files, epoch, rank, size)
         n_mine = sum(self._file_sizes[f] for f in files)
         return n_mine // global_batch
+
+    def ingest_signature(self) -> dict:
+        """What a remote ingest reader must agree on for its stream to
+        be byte-identical to this dataset's (theanompi_tpu/ingest):
+        the seed (every rng above derives from it) and the exact shard
+        set.  Compared against the reader's ``ingest_meta`` at
+        RemoteBatchSource construction — a silent mismatch would train
+        on a different permutation (or different data) while looking
+        healthy."""
+        if self.synthetic:
+            raise RuntimeError(
+                "synthetic datasets have no shard tree to serve "
+                "remotely; distributed ingest needs a prepared "
+                "data_dir (docs/DESIGN.md 'Distributed ingest')")
+        return shard_tree_signature(self.train_files, self._file_sizes,
+                                    self.seed)
 
 
 def _update_manifest(out_dir: str, entries: dict[str, int]) -> None:

@@ -20,6 +20,7 @@ from typing import Iterable, Iterator
 
 import jax
 
+from theanompi_tpu import monitor
 from theanompi_tpu.parallel.mesh import shard_batch
 
 
@@ -37,14 +38,22 @@ class DevicePrefetcher:
     could deliver if the consumer never ran — the in-session ingest
     number the round-4 verdict asked for, cleanly separated from
     device compute that shares the host core on CPU meshes.
+
+    The same numbers are exported as ``ingest/loader_*`` monitor
+    series (labelled ``source='local'|'remote'``), so a run fed by the
+    in-process loader and one fed by a remote reader fleet
+    (theanompi_tpu/ingest) are graphed on the same dashboard rows —
+    docs/OBSERVABILITY.md.
     """
 
     _SENTINEL = object()
 
     def __init__(self, host_batches: Iterable, mesh, depth: int = 2,
-                 spec=None, images_per_batch: int | None = None):
+                 spec=None, images_per_batch: int | None = None,
+                 source: str = "local"):
         self.mesh = mesh
         self.spec = spec  # PartitionSpec override (default: data axis)
+        self._source = source  # 'local' | 'remote' monitor label
         # stacked cadences (steps_per_call / grad_accum) stage
         # (k, global_batch, ...) leaves, where leaves[0].shape[0] is k,
         # not an image count — callers that stack must say how many
@@ -79,6 +88,19 @@ class DevicePrefetcher:
                     leaves = jax.tree.leaves(staged)
                     if leaves:
                         s["images"] += leaves[0].shape[0]
+                if monitor.enabled():
+                    # the loader-rate series local and remote ingest
+                    # share (class docstring); strictly gated — the
+                    # monitor-off hot path pays one branch
+                    monitor.set_gauge("ingest/loader_img_s",
+                                      s["images"] / s["busy_s"]
+                                      if s["busy_s"] else 0.0,
+                                      source=self._source)
+                    monitor.set_gauge("ingest/loader_queue_depth",
+                                      self._q.qsize(),
+                                      source=self._source)
+                    monitor.inc("ingest/loader_batches_total",
+                                source=self._source)
                 while not self._stop.is_set():
                     try:
                         self._q.put(staged, timeout=0.1)

@@ -1,0 +1,24 @@
+"""theanompi_tpu.ingest — distributed ingest service.
+
+A standalone reader fleet that feeds M trainers like one loader
+(docs/DESIGN.md "Distributed ingest"): N reader processes own disjoint
+batch ranges of the mmap shard tree and stream assembled uint8 batches
+to trainers over raw wire-v2 frames; a coordinator assigns ranges,
+drives shuffle-epoch boundaries, and reassigns a dead reader's ranges
+mid-epoch; a trainer-side :class:`RemoteBatchSource` plugs into
+``DevicePrefetcher`` so the rules switch on nothing but the launcher's
+``--ingest`` flag.  The remote stream is byte-identical to the
+in-process loader for the same seed — reader and trainer derive one
+epoch permutation from (seed, epoch) with zero coordination.
+"""
+
+from theanompi_tpu.ingest.client import RemoteBatchSource, ingest_addresses
+from theanompi_tpu.ingest.coordinator import IngestCoordinator
+from theanompi_tpu.ingest.fleet import IngestProcessGroup
+from theanompi_tpu.ingest.order import EpochOrder
+from theanompi_tpu.ingest.reader import IngestReader
+
+__all__ = [
+    "EpochOrder", "IngestCoordinator", "IngestProcessGroup",
+    "IngestReader", "RemoteBatchSource", "ingest_addresses",
+]

@@ -100,6 +100,20 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                         "re-seeds only its leaf range.  Multi-host runs "
                         "point every host at one fleet via a "
                         "comma-separated --server-addr instead")
+    p.add_argument("--ingest", default=None, metavar="ADDR[,ADDR...]",
+                   help="distributed ingest (theanompi_tpu/ingest, "
+                        "docs/DESIGN.md 'Distributed ingest'): pull "
+                        "train batches from a standalone reader fleet "
+                        "instead of the in-process loader.  ONE "
+                        "address names the fleet's coordinator; a "
+                        "comma-separated list names the readers "
+                        "directly (static fleet, plan derived "
+                        "client-side).  The stream is byte-identical "
+                        "to the local loader for the same dataset "
+                        "seed; exported as THEANOMPI_TPU_INGEST so "
+                        "every epoch's loader (and any subprocess) "
+                        "picks it up.  Start a fleet with tmingest or "
+                        "python -m theanompi_tpu.ingest.fleet")
     p.add_argument("--overlap-exchange", action="store_true",
                    help="EASGD/ASGD: run each worker's parameter "
                         "exchange on a dedicated thread so compute "
@@ -293,6 +307,29 @@ def _run(args, multihost: bool) -> int:
         from theanompi_tpu.resilience import faults
 
         faults.install_from_env()
+    if args.ingest:
+        if args.rule == "SERVE":
+            raise SystemExit("--ingest feeds TRAINING batches; the "
+                             "SERVE rule has no train loader")
+        if multihost:
+            # a multi-host SPMD program slices each global batch per
+            # host locally; silently ignoring the flag would let the
+            # user believe the fleet is feeding the run when it is not
+            raise SystemExit(
+                "--ingest is single-host for now (each host of a "
+                "tmlauncher program feeds its own slice); run the "
+                "readers co-located with each host instead")
+        import os
+
+        from theanompi_tpu.ingest.protocol import ingest_addresses
+
+        try:
+            ingest_addresses(args.ingest)  # fail fast on a bad spec
+        except ValueError as e:
+            raise SystemExit(f"--ingest: {e}") from None
+        # env is the channel: models/base.py begin_epoch reads it each
+        # epoch, and subprocesses this run spawns inherit it
+        os.environ["THEANOMPI_TPU_INGEST"] = args.ingest
     if args.platform:
         import jax
 

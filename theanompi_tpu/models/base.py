@@ -492,6 +492,7 @@ class TpuModel:
         self.eval_step = None
         self._train_prefetcher: DevicePrefetcher | None = None
         self._train_iter: Iterator | None = None
+        self._ingest_source = None  # RemoteBatchSource when --ingest
         self._pending: list[tuple[int, dict]] = []
 
     # -- hooks for subclasses ------------------------------------------------
@@ -771,7 +772,28 @@ class TpuModel:
         # at an epoch boundary replays EXACTLY the continuous run's
         # draws (not merely statistically equivalent ones)
         self._rng = self._epoch_rng(epoch)
-        if self.multiprocess:
+        # distributed ingest (theanompi_tpu/ingest): with
+        # THEANOMPI_TPU_INGEST set (launcher --ingest), the epoch's
+        # host batches come from the remote reader fleet instead of
+        # this process's loader thread — byte-identical stream, same
+        # DevicePrefetcher downstream, rules untouched.  Multi-host
+        # SPMD programs keep the local per-host slicing path (each
+        # host feeds only its slice of every global batch).
+        ingest = None
+        if not self.multiprocess:
+            from theanompi_tpu.ingest.client import ingest_addresses
+
+            ingest = ingest_addresses()
+        if ingest:
+            from theanompi_tpu.ingest.client import RemoteBatchSource
+
+            self._ingest_source = RemoteBatchSource(
+                ingest, data=self.data, epoch=epoch,
+                global_batch=self.global_batch,
+                rank=self.shard_rank, size=self.shard_size)
+            host_iter = self._ingest_source
+            n_iters = self._ingest_source.n_batches
+        elif self.multiprocess:
             host_iter = self.data.host_train_batches(
                 epoch, self.global_batch, self.host_rank, self.host_count)
             n_iters = self.data.n_train_batches_for(epoch, self.global_batch)
@@ -802,7 +824,8 @@ class TpuModel:
                                           if self.multiprocess else 1)
         self._train_prefetcher = DevicePrefetcher(
             host_iter, self.mesh, spec=spec,
-            images_per_batch=host_rows * stack)
+            images_per_batch=host_rows * stack,
+            source="remote" if ingest else "local")
         self._train_iter = iter(self._train_prefetcher)
         return n_iters
 
@@ -1016,6 +1039,12 @@ class TpuModel:
             self._train_prefetcher.close()
             self._train_prefetcher = None
             self._train_iter = None
+        if self._ingest_source is not None:
+            # the prefetcher abandons its host iterator; the remote
+            # source's fetcher threads + connections need an explicit
+            # close (thread-leak fence, tests/conftest.py)
+            self._ingest_source.close()
+            self._ingest_source = None
 
     def cleanup(self) -> None:
         self.cleanup_iter()

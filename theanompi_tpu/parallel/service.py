@@ -326,7 +326,14 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
         stop_event = threading.Event()  # so the shutdown op works
     if authkey is None:
         authkey = _authkey(generate=True)
-    listener = Listener((host, port), authkey=authkey)
+    # backlog: the stdlib default is 1, and on Linux a connect that
+    # overflows the accept queue looks ESTABLISHED to the client while
+    # the server never saw it — the client then blocks forever waiting
+    # for an HMAC challenge that will never come.  A burst of
+    # legitimate connects (an ingest trainer fleet opening control +
+    # pipelined-pull connections, K shard clients, a worker pool
+    # reconnecting after a restart) must queue, not wedge.
+    listener = Listener((host, port), backlog=64, authkey=authkey)
     if ready_event is not None:
         ready_event.set()
     # live established connections, closed when the serve loop exits:

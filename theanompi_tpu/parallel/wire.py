@@ -88,6 +88,10 @@ DEFAULT_BUF_TIMEOUT_S = float(os.environ.get(
 
 _FLAG_SKELETON_ZLIB = 1
 
+#: per-leaf options for :class:`RawArrays` members — raw transport no
+#: matter what the connection negotiated
+_RAW_OPTS = None  # filled in below WireOptions (forward declaration)
+
 
 class WireError(RuntimeError):
     """Base class for wire-protocol failures."""
@@ -139,6 +143,38 @@ class WireOptions:
         )
 
 
+_RAW_OPTS = WireOptions(compression="none", dtype="f32")
+
+
+class RawArrays(tuple):
+    """Marks a tuple of ndarrays as a **raw batch frame** (the ingest
+    uint8-batch op, docs/DESIGN.md "Distributed ingest"): each array
+    is sent as its own zero-copy buffer with the per-leaf options
+    FORCED to raw — no zlib attempt (level-1 zlib on a 25 MB uint8
+    image batch costs real CPU per batch and essentially never
+    shrinks photographic content) and no bf16 re-dtype (uint8 pixels
+    and int32 labels must arrive bit-exact; the f32→bf16 wire dtype
+    only ever applied to f32 anyway, but the batch path must not
+    depend on that).  Decodes to a plain tuple of arrays, so the
+    consumer sees ``(x, y)`` with no wire-layer type leaking out."""
+
+    __slots__ = ()
+
+    def __new__(cls, *arrays: np.ndarray):
+        for a in arrays:
+            if not isinstance(a, np.ndarray):
+                raise TypeError(
+                    f"RawArrays carries ndarrays only, got {type(a)}")
+        return super().__new__(cls, arrays)
+
+    def __getnewargs__(self):
+        # pickle support: tuple subclasses pickle through __new__, and
+        # ours takes *arrays, not one iterable — without this a v1
+        # (pickle) connection crashes decoding a batch reply instead
+        # of delivering it (pinned by tests/test_wire.py)
+        return tuple(self)
+
+
 @dataclasses.dataclass
 class WireStats:
     """Byte accounting for one frame: ``pre`` is the logical payload
@@ -177,6 +213,12 @@ def _encode_node(obj: Any, bufs: list, opts: WireOptions, stats: WireStats):
         import base64
 
         return {"t": "by", "v": base64.b64encode(obj).decode("ascii")}
+    if isinstance(obj, RawArrays):
+        # the raw batch frame: per-leaf options forced to raw transport
+        # regardless of what the connection negotiated (class docstring)
+        return {"t": "raw",
+                "v": [_encode_array(a, bufs, _RAW_OPTS, stats)
+                      for a in obj]}
     if isinstance(obj, np.ndarray):
         return _encode_array(obj, bufs, opts, stats)
     if isinstance(obj, np.generic):  # numpy scalar (np.float32(3), ...)
@@ -269,6 +311,11 @@ def _decode_node(node: Any, bufs: list, opts: WireOptions) -> Any:
         return np.dtype(node["dtype"]).type(node["v"])
     if t == "nd":
         return _decode_array(node, bufs)
+    if t == "raw":
+        # a raw batch frame decodes to a plain tuple of arrays; each
+        # element must be an array node (malformed ones raise the same
+        # typed error as any corrupt skeleton)
+        return tuple(_decode_array(v, bufs) for v in node["v"])
     if t == "tuple":
         return tuple(_decode_node(v, bufs, opts) for v in node["v"])
     if t == "list":

@@ -26,6 +26,16 @@ Spec fields:
     fails the batch and exercises restart-from-export, ``delay``
     slows a replica so admission control trips) and ``serve_rpc``
     (the inference server's per-request handler; coord ``op``).
+    Distributed ingest (docs/DESIGN.md "Distributed ingest") adds
+    ``ingest_batch`` (reader-side batch assembly; coords ``reader``,
+    ``epoch``, ``index`` — ``delay`` makes a reader a straggler;
+    ``raise`` surfaces a typed server error that FAILS the trainer's
+    stream fast — the client only retries typed ``Overloaded`` and
+    only fails over on transport errors, so reader-death drills use a
+    real kill, e.g. ``IngestProcessGroup.kill_reader`` or the bench
+    ``--smoke`` leg) and ``ingest_pull`` (trainer-side fetch; coords
+    ``index``, ``rank`` — ``raise`` injects a trainer-side stream
+    failure).
 ``action``
     ``raise`` (default) raises :class:`FaultInjected` at the site;
     ``delay`` sleeps ``delay_s`` seconds (default 0.1) then lets the

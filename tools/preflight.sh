@@ -223,7 +223,24 @@ PYEOF
   SHARD_RC=$?
   rm -rf "$SHARDDIR"
   echo "shard smoke rc=$SHARD_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ]; then
+  echo "## ingest smoke (2-reader fleet over real sockets + kill-recovery, docs/DESIGN.md 'Distributed ingest')"
+  # the distributed-ingest vertical end-to-end: two REAL reader
+  # processes serving a real mmap shard tree to trainer worker
+  # processes over pipelined wire-v2 raw batch frames.  The gate
+  # asserts N=2 aggregate img/s >= 1.7x N=1 at identical total bytes,
+  # BOTH readers served their ranges (per-reader ingest_pull spans +
+  # served counters), and the kill leg recovered — reader 0 SIGKILLed
+  # mid-epoch, the client fails over (stream completes), the fleet
+  # watcher relaunches it, and the recovery counters land in the
+  # monitor JSONL (tools/bench_ingest.py --smoke, exit 1 on any miss)
+  INGESTDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$INGESTDIR" \
+    python tools/bench_ingest.py --smoke \
+      --out "$INGESTDIR/BENCH_ingest_smoke.json"
+  INGEST_RC=$?
+  rm -rf "$INGESTDIR"
+  echo "ingest smoke rc=$INGEST_RC"
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
