@@ -152,7 +152,7 @@ def test_inventory_reflects_repo_emissions():
             "resilience/worker_restarts_total"} <= names
     sites = {f.site for f in site_coverage.collect_fires(files)}
     assert {"worker_step", "service_call", "exchange", "checkpoint",
-            "serve_step", "serve_rpc", "ingest_batch",
+            "serve_step", "serve_rpc", "decode_step", "ingest_batch",
             "ingest_pull"} == sites
 
 

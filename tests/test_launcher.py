@@ -69,6 +69,41 @@ def test_parser_serve_mode():
              multihost=True)
 
 
+def test_parser_serve_decode_mode(monkeypatch, tmp_path):
+    """SERVE --decode (theanompi_tpu/decode): the knobs parse, reach
+    serve_main as decode_opts, and --decode outside SERVE fails fast
+    (silently ignoring it would fake a live decode plane)."""
+    import theanompi_tpu.serving.server as srv
+    from theanompi_tpu.launcher import _run
+
+    p = _build_parser(multihost=False)
+    args = p.parse_args(["SERVE", "--export-dir", "/tmp/exp",
+                         "--decode", "--decode-page-size", "4",
+                         "--decode-pages-per-seq", "2",
+                         "--decode-max-seqs", "16",
+                         "--decode-max-pending", "64",
+                         "--decode-prefill-buckets", "8,32"])
+    assert args.decode and args.decode_page_size == 4
+    seen = {}
+
+    def fake_serve_main(export_dir, **kw):
+        seen.update(kw, export_dir=export_dir)
+        return 0
+
+    monkeypatch.setattr(srv, "serve_main", fake_serve_main)
+    _run(args, multihost=False)
+    assert seen["decode"] is True
+    assert seen["decode_opts"] == {
+        "page_size": 4, "pages_per_seq": 2, "max_seqs": 16,
+        "max_pending": 64, "prefill_buckets": (8, 32)}
+    # default: decode off, opts None
+    _run(p.parse_args(["SERVE", "--export-dir", "/tmp/exp"]),
+         multihost=False)
+    assert seen["decode"] is False and seen["decode_opts"] is None
+    with pytest.raises(SystemExit):  # --decode is a SERVE option
+        _run(p.parse_args(["BSP", "--decode"]), multihost=False)
+
+
 def test_serve_defaults_to_supervised_recovery(monkeypatch, tmp_path):
     """tmlocal SERVE without --max-restarts must hand serve_main the
     serving default (2), not training's fail-fast 0 — otherwise one

@@ -1,0 +1,51 @@
+"""theanompi_tpu.decode — autoregressive serving for the transformer
+family (docs/SERVING.md "Decode").
+
+The serving subsystem (``theanompi_tpu/serving``) batches fixed-shape
+eval requests — right for the CNN zoo, wrong for token generation,
+where every request is a loop whose state (the KV cache) must live on
+the device between steps.  This package adds that loop:
+
+* ``kvcache``   — paged/ring KV cache: one fixed page pool per
+  replica, per-sequence page tables, ring eviction past the context
+  window (pure-functional JAX state);
+* ``model``     — cached-attention forward sharing weights with the
+  training ``Block`` (the exported ``TransformerLMNet`` params,
+  applied through the same flax submodules);
+* ``session``   — ``DecodeSession``: prefill/decode bucket split with
+  cache-buffer donation; steady state never recompiles (compile-
+  counter pinned);
+* ``scheduler`` — ``ContinuousBatcher``: iteration-level scheduling —
+  admit/evict sequences BETWEEN decode steps — plus ``DecodeReplica``,
+  the restart-from-export wrapper the inference server pools.
+
+Wire surface: the inference server's ``generate`` op
+(``InferenceClient.generate``), served by ``tmlocal SERVE --decode``.
+
+    # exporter side (a trained TransformerLM)
+    from theanompi_tpu.serving import export_model
+    export_model(model, "exports/lm", weight_dtype="bf16")
+
+    # server:  tmlocal SERVE --export-dir exports/lm --decode
+    # client
+    from theanompi_tpu.serving import InferenceClient
+    tokens = InferenceClient("host:45900").generate(prompt, max_new=64)
+"""
+
+from theanompi_tpu.decode.kvcache import CacheConfig, PagePool
+from theanompi_tpu.decode.model import full_forward
+from theanompi_tpu.decode.scheduler import (
+    ContinuousBatcher,
+    DecodePolicy,
+    DecodeReplica,
+)
+from theanompi_tpu.decode.session import (
+    DecodeSession,
+    default_prefill_buckets,
+)
+
+__all__ = [
+    "CacheConfig", "PagePool", "full_forward", "ContinuousBatcher",
+    "DecodePolicy", "DecodeReplica", "DecodeSession",
+    "default_prefill_buckets",
+]

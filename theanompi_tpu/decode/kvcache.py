@@ -1,0 +1,211 @@
+"""Paged/ring KV-cache — pure-functional JAX state + a host-side pool.
+
+The decode subsystem's device state is ONE fixed page pool per replica
+(``k_pages``/``v_pages``: ``(layers, n_pages, page_size, heads,
+d_head)``), never resized and never reshaped: every jitted decode step
+sees the same array shapes regardless of which sequences are live, so
+a replica compiles each (prefill bucket, decode bucket) program ONCE
+and steady-state serving never recompiles — the inference-side twin of
+the training stack's bucket discipline (serving/batcher.py).
+
+Sequences own pages through a **page table** (``pages_per_seq`` page
+ids per live sequence, allocated from the pool's free list on
+admission, returned on eviction), so a sequence's KV bytes are
+scattered wherever free pages were — admission cost is O(pages), not
+a copy.  Within its pages a sequence is a **ring** over
+``window = pages_per_seq * page_size`` token slots: token at absolute
+position ``p`` lives in slot ``p % window``, and once ``p >= window``
+the write lands on the slot of token ``p - window`` — eviction past
+the context window is free, it is the ring wrapping.  Attention
+therefore covers exactly the last ``window`` tokens; the full-forward
+oracle for a decode past the boundary is the SAME model with a
+sliding-window causal mask (decode/model.py ``full_forward``), which
+tests pin token-identical (tests/test_decode.py).
+
+Everything here is either pure math safe inside ``jax.jit``
+(gather/scatter/mask helpers — no host syncs) or host-side allocator
+state owned by ONE scheduler thread (``PagePool`` — no locks by
+design; decode/scheduler.py is the single caller).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Shape contract of one replica's page pool."""
+
+    n_layers: int
+    n_heads: int
+    d_head: int
+    #: tokens per page (the allocation granule)
+    page_size: int = 16
+    #: pages per live sequence — fixes the ring window
+    pages_per_seq: int = 8
+    #: max concurrently-live sequences (the decode batch ceiling)
+    max_seqs: int = 8
+    #: KV storage dtype (the model's compute dtype)
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        for f in ("n_layers", "n_heads", "d_head", "page_size",
+                  "pages_per_seq", "max_seqs"):
+            if int(getattr(self, f)) < 1:
+                raise ValueError(f"CacheConfig.{f} must be >= 1")
+
+    @property
+    def window(self) -> int:
+        """Ring capacity in tokens = the attention context window."""
+        return self.page_size * self.pages_per_seq
+
+    @property
+    def n_pages(self) -> int:
+        """Pool size: every slot's worth of sequences can hold a full
+        ring (admission can only fail on max_seqs, never on pages)."""
+        return self.max_seqs * self.pages_per_seq
+
+
+def init_pages(cfg: CacheConfig):
+    """The replica's page pool, zeros: ``(k_pages, v_pages)`` of shape
+    ``(n_layers, n_pages, page_size, n_heads, d_head)``."""
+    shape = (cfg.n_layers, cfg.n_pages, cfg.page_size, cfg.n_heads,
+             cfg.d_head)
+    dt = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+# ---------------------------------------------------------------------------
+# Ring math (pure; used on host by tests and inside jit by the session)
+# ---------------------------------------------------------------------------
+
+
+def stored_positions(lengths, window: int):
+    """Absolute token position held by each ring slot.
+
+    ``lengths``: (S,) tokens written so far per sequence.  Slot ``j``
+    of a ring holds the LARGEST position ``p < length`` with
+    ``p % window == j`` — i.e. ``p_j = (length-1) - ((length-1-j) mod
+    window)``; a slot no position has reached yet comes out negative.
+    Returns (S, window) int32.
+    """
+    j = jnp.arange(window, dtype=jnp.int32)[None, :]
+    last = lengths.astype(jnp.int32)[:, None] - 1
+    return last - jnp.mod(last - j, window)
+
+
+def cache_mask(lengths, window: int):
+    """(S, window) bool: ring slots holding a position the NEXT token
+    (at position ``length``) may attend — written (``p >= 0``) and
+    inside the sliding window (``p > length - window``; the slot the
+    new token is about to overwrite holds ``length - window`` and is
+    correctly excluded)."""
+    pos = stored_positions(lengths, window)
+    lens = lengths.astype(jnp.int32)[:, None]
+    return (pos >= 0) & (pos > lens - window)
+
+
+def ring_from_prompt(kv, length, window: int):
+    """Scatter one prompt's per-position K or V into its ring layout.
+
+    ``kv``: (T_pad, heads, d_head) for one sequence, position ``p`` at
+    row ``p``; ``length``: the real prompt length (<= T_pad).  Only the
+    last ``min(length, window)`` positions survive (the rest are
+    already evicted); each lands in slot ``p % window`` — at most one
+    surviving position per slot, so the scatter has no duplicate
+    indices.  Pad rows scatter to index ``window`` and are dropped.
+    Returns (window, heads, d_head).
+    """
+    t_pad = kv.shape[0]
+    pos = jnp.arange(t_pad, dtype=jnp.int32)
+    length = jnp.asarray(length, jnp.int32)
+    valid = (pos < length) & (pos >= length - window)
+    slots = jnp.where(valid, jnp.mod(pos, window), window)
+    ring = jnp.zeros((window, *kv.shape[1:]), kv.dtype)
+    return ring.at[slots].set(kv, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# Page gather/scatter (pure; inside jit)
+# ---------------------------------------------------------------------------
+
+
+def gather_layer(pages, page_rows):
+    """One layer's cached KV per sequence, ring-ordered.
+
+    ``pages``: (n_pages, page_size, H, D) — ONE layer of the pool;
+    ``page_rows``: (S, pages_per_seq) page ids.  Returns
+    (S, window, H, D): slot ``j`` is page ``j // page_size`` offset
+    ``j % page_size``.
+    """
+    s, pps = page_rows.shape
+    g = pages[page_rows]                     # (S, pps, page_size, H, D)
+    return g.reshape(s, pps * pages.shape[1], *pages.shape[2:])
+
+
+def write_token_all(pages, page_rows, lengths, active, kv):
+    """Write each sequence's NEW token (position ``length``) into the
+    pool at ring slot ``length % window``, all layers in one scatter.
+
+    ``pages``: the full pool (L, n_pages, page_size, H, D); ``kv``:
+    (L, S, H, D) — each layer's new-token K or V.  Slot/page math is
+    shared across layers (same sequences), so the write is one batched
+    ``.at[:, page, off].set``; inactive (bucket-padding) rows are
+    routed to page id ``n_pages`` and dropped by the scatter, so
+    padding can never clobber a live page.
+    """
+    page_size = pages.shape[2]
+    window = page_rows.shape[1] * page_size
+    slot = jnp.mod(lengths.astype(jnp.int32), window)
+    page = jnp.take_along_axis(page_rows,
+                               (slot // page_size)[:, None], axis=1)[:, 0]
+    page = jnp.where(active, page, pages.shape[1])
+    off = jnp.mod(slot, page_size)
+    return pages.at[:, page, off].set(kv, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# Host-side allocator
+# ---------------------------------------------------------------------------
+
+
+class PagePool:
+    """Free-list page allocator for one replica's pool.
+
+    Owned by the replica's single scheduler thread
+    (decode/scheduler.py) — not thread-safe by design, the same
+    single-owner discipline as the session's host-side sequence state.
+    """
+
+    def __init__(self, cfg: CacheConfig):
+        self.cfg = cfg
+        self._free = list(range(cfg.n_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_fraction(self) -> float:
+        return 1.0 - len(self._free) / self.cfg.n_pages
+
+    def alloc_seq(self) -> np.ndarray | None:
+        """One sequence's page row (``pages_per_seq`` ids), or None
+        when the pool cannot cover it."""
+        n = self.cfg.pages_per_seq
+        if len(self._free) < n:
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        return np.asarray(ids, np.int32)
+
+    def free_seq(self, page_row: np.ndarray) -> None:
+        for p in page_row.tolist():
+            if not 0 <= p < self.cfg.n_pages:
+                raise ValueError(f"freeing foreign page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)

@@ -1,0 +1,145 @@
+"""Cached-attention forward over the TRAINING transformer's params.
+
+The decode path shares weights with ``models/transformer.py`` — the
+exact ``TransformerLMNet`` param tree an export freezes — but its two
+access patterns (full prompt prefill that must EMIT per-layer K/V, and
+one-token decode that must READ a paged cache) don't fit the training
+module's ``__call__``.  Rather than fork the module, this file applies
+the SAME flax submodules (``nn.Dense``/``nn.LayerNorm`` over the
+exported subtrees — identical numerics, zero duplicated math) in two
+hand-rolled schedules:
+
+* ``full_forward`` — logits + per-layer K/V for a (B, T) prompt, with
+  an optional **sliding-window** causal mask (``window`` = the KV
+  ring's capacity, decode/kvcache.py).  With ``window=None`` it is the
+  training eval path (pinned argmax-identical to ``module.apply`` in
+  tests/test_decode.py); with a window it is the oracle for decode
+  past an eviction boundary.
+* ``decode_block`` / ``embed_tokens`` / ``final_logits`` — the pieces
+  the session's one-token decode step composes around the paged
+  gather (decode/session.py): attention of one new query against the
+  gathered ring plus the token itself.
+
+Quantized exports ride through ``dequantize_tree`` (serving/export.py)
+applied INSIDE the jitted fns — int8 weights live on-device at 1/4 the
+bytes and rematerialize as f32 per step, or are collapsed once at load
+(docs/SERVING.md "Quantized exports").
+
+All functions here are jit-traced (no host syncs — analysis TM301).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.ops.attention import _MASK_NEG, block_scores
+
+
+def _ln(p, x, dtype):
+    return nn.LayerNorm(dtype=dtype).apply({"params": p}, x)
+
+
+def _dense(p, x, dtype):
+    return nn.Dense(p["kernel"].shape[-1], use_bias="bias" in p,
+                    dtype=dtype).apply({"params": p}, x)
+
+
+def embed_tokens(params, tokens, positions):
+    """Embedding gather + positional add for arbitrary absolute
+    positions (prefill uses 0..T-1; decode uses each sequence's
+    current length).  Returns f32 (…, d_model) — the cast to the
+    compute dtype happens at the caller, matching the training net."""
+    x = jnp.take(params["Embed_0"]["embedding"], tokens, axis=0)
+    return x + jnp.take(params["pos_emb"], positions, axis=0)
+
+
+def final_logits(params, x, dtype):
+    """Final LayerNorm + LM head -> f32 logits."""
+    h = _ln(params["LayerNorm_0"], x, dtype)
+    return _dense(params["Dense_0"], h, dtype).astype(jnp.float32)
+
+
+def _block_full(bp, x, n_heads: int, dtype, window: int | None):
+    """One pre-LN block over a full (B, T, D) sequence; returns the
+    block output and the block's K/V (B, T, H, Dh) for the cache."""
+    b, t, d = x.shape
+    d_head = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    shape = (b, t, n_heads, d_head)
+    q = _dense(bp["q_proj"], h, dtype).reshape(shape)
+    k = _dense(bp["k_proj"], h, dtype).reshape(shape)
+    v = _dense(bp["v_proj"], h, dtype).reshape(shape)
+    s = block_scores(q, k, d_head ** -0.5)            # (B, H, T, T) f32
+    qi = jnp.arange(t, dtype=jnp.int32)[:, None]
+    kj = jnp.arange(t, dtype=jnp.int32)[None, :]
+    mask = kj <= qi
+    if window is not None:
+        mask = mask & (qi - kj < window)
+    s = jnp.where(mask[None, None], s, _MASK_NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    x = x + _dense(bp["o_proj"], o.reshape(b, t, d), dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    h2 = jax.nn.gelu(_dense(bp["mlp_up"], h2, dtype))
+    x = x + _dense(bp["mlp_down"], h2, dtype)
+    return x, k, v
+
+
+def full_forward(params, tokens, n_layers: int, n_heads: int, dtype,
+                 window: int | None = None):
+    """Whole-prompt forward: (B, T) int tokens -> (f32 logits
+    (B, T, V), [k_l], [v_l]) with per-layer K/V (B, T, H, Dh).
+
+    ``window`` bounds attention to the last ``window`` positions per
+    query — the KV ring's eviction semantics expressed as a mask, so
+    this IS the oracle decode must match across an eviction boundary.
+    """
+    t = tokens.shape[1]
+    x = embed_tokens(params, tokens, jnp.arange(t, dtype=jnp.int32))
+    x = x.astype(dtype)
+    ks, vs = [], []
+    for i in range(n_layers):
+        x, k, v = _block_full(params[f"Block_{i}"], x, n_heads, dtype,
+                              window)
+        ks.append(k)
+        vs.append(v)
+    return final_logits(params, x, dtype), ks, vs
+
+
+def decode_block(bp, x, k_cache, v_cache, mask, n_heads: int, dtype):
+    """One block for ONE new token per sequence against the ring.
+
+    ``x``: (S, 1, D) the token's residual stream; ``k_cache``/
+    ``v_cache``: (S, W, H, Dh) gathered ring (this layer, PRE-write);
+    ``mask``: (S, W) valid-slot mask (decode/kvcache.cache_mask — the
+    slot this token will overwrite is already excluded).  The token
+    attends to the masked ring PLUS itself (its K/V are appended as a
+    W+1'th key, exactly the self-attention term the ring does not hold
+    yet).  Returns (x_out (S, 1, D), k_new (S, H, Dh), v_new).
+    """
+    s_, _, d = x.shape
+    d_head = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    shape = (s_, 1, n_heads, d_head)
+    q = _dense(bp["q_proj"], h, dtype).reshape(shape)
+    k_new = _dense(bp["k_proj"], h, dtype).reshape(shape)
+    v_new = _dense(bp["v_proj"], h, dtype).reshape(shape)
+    scale = d_head ** -0.5
+    # scores against the ring: (S, H, 1, W) f32, masked per slot
+    sc = block_scores(q, k_cache, scale)
+    sc = jnp.where(mask[:, None, None, :], sc, _MASK_NEG)
+    # the token's own score: q . k_new -> (S, H, 1, 1)
+    self_sc = block_scores(q, k_new, scale)
+    logits = jnp.concatenate([sc, self_sc], axis=-1)   # (S, H, 1, W+1)
+    p = jax.nn.softmax(logits, axis=-1)
+    o_cache = jnp.einsum("bhqk,bkhd->bqhd",
+                         p[..., :-1].astype(v_cache.dtype), v_cache)
+    o_self = p[..., -1:].transpose(0, 3, 1, 2).astype(v_new.dtype) * v_new
+    o = (o_cache + o_self).reshape(s_, 1, d)
+    x = x + _dense(bp["o_proj"], o, dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    h2 = jax.nn.gelu(_dense(bp["mlp_up"], h2, dtype))
+    x = x + _dense(bp["mlp_down"], h2, dtype)
+    return x, k_new[:, 0], v_new[:, 0]

@@ -1,0 +1,482 @@
+"""ContinuousBatcher — iteration-level scheduling for token decode.
+
+``serving/batcher.py`` coalesces REQUESTS: a batch forms, runs once,
+and every member completes together.  Token generation breaks that
+shape — sequences finish at different lengths, and a per-request batch
+would hold 1-token stragglers hostage to 64-token neighbors.  This
+scheduler batches ITERATIONS instead (the continuous-batching
+discipline): between any two decode steps it may **admit** pending
+prompts into free cache slots and **evict** finished sequences, so a
+request admitted mid-stream shares its very first decode step with
+whatever is already in flight (pinned by tests/test_decode.py and the
+preflight decode smoke) and an evicted slot is refilled without
+draining the batch.
+
+What carries over from ``DynamicBatcher`` unchanged:
+
+* **typed O(1) admission** — a full pending queue raises
+  :class:`~theanompi_tpu.serving.batcher.Overloaded` immediately (the
+  same class, so it rides the wire's ``err`` prefix identically);
+* **deadline-from-oldest** — here the oldest pending prompt's wait is
+  bounded by ONE decode step + its prefill, because admission runs
+  every iteration rather than at batch boundaries;
+* the **dead-replica contract** — a step failure hands the exception
+  to ``on_error``; a falsy return marks the batcher dead, pending and
+  future submits get ``Overloaded``, and the server routes around the
+  corpse (``DecodeReplica`` owns restart-from-export, exactly like
+  ``Replica``).
+
+Telemetry: per-token inter-token latency (``decode/intertoken_ms`` —
+the serving SLO, not request latency), tokens/steps counters, active/
+pending gauges, cache occupancy and evictions — all in the monitor
+registry (docs/OBSERVABILITY.md) plus a host-side p50/p99 ring in
+``stats()`` for the bench tools.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
+from theanompi_tpu.resilience import faults
+from theanompi_tpu.serving.batcher import Overloaded
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodePolicy:
+    """Admission/generation knobs for one decode replica."""
+
+    #: admission bound: pending PROMPTS beyond this are rejected with
+    #: Overloaded instead of queued (docs/SERVING.md overload
+    #: semantics)
+    max_pending: int = 32
+    #: server-side cap on tokens generated per request
+    max_new_cap: int = 256
+    #: a blocked generate() gives up after this long
+    submit_timeout_s: float = 120.0
+    #: greedy decode stops early on this token (None = length-only)
+    eos_token: int | None = None
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "out", "done", "error", "t0",
+                 "t_last", "cancelled")
+
+    def __init__(self, prompt: np.ndarray, max_new: int):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.out: list[int] = []
+        self.done = threading.Event()
+        self.error: BaseException | None = None
+        self.t0 = time.monotonic()
+        self.t_last = self.t0
+        #: set by an abandoning client thread, read by the scheduler at
+        #: the next step boundary — a benign boolean race (either the
+        #: scheduler sees it this step or the next)
+        self.cancelled = False
+
+
+class ContinuousBatcher:
+    """One decode replica's scheduler thread + admission queue.
+
+    ``session`` is a :class:`~theanompi_tpu.decode.session.DecodeSession`;
+    its cache state is owned by THIS object's single scheduler thread.
+    ``generate`` is the client-side entry (any thread)."""
+
+    def __init__(self, session, policy: DecodePolicy | None = None,
+                 replica: int = 0, on_error=None):
+        self.session = session
+        self.policy = policy or DecodePolicy()
+        self.replica = int(replica)
+        self._on_error = on_error
+        self._pending: deque[_GenRequest] = deque()  # guarded_by: self._lock
+        self._lock = make_lock("ContinuousBatcher._lock")
+        self._cond = make_condition(self._lock)
+        self._dead = False                           # guarded_by: self._lock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # scheduler-thread-owned live set: (request, session _Seq)
+        self._active: list[tuple[_GenRequest, object]] = []
+        self._steps = 0
+        # plain-int stats (torn reads of monotonic ints are harmless
+        # for stats(), the DynamicBatcher convention)
+        self.n_tokens = 0
+        self.n_steps = 0
+        self.n_admitted = 0
+        self.n_evicted = 0
+        self.n_overloaded = 0
+        self.n_step_errors = 0
+        #: steps whose decode batch held >= 2 sequences — the
+        #: iteration-level-sharing proof the preflight smoke asserts
+        self.shared_steps = 0
+        self.max_concurrent = 0
+        self._intertoken_ms: deque[float] = deque(maxlen=4096)  # guarded_by: self._lock
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ContinuousBatcher":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"decode-scheduler-{self.replica}")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        self._fail_pending(Overloaded(
+            f"decode replica {self.replica} is shutting down"))
+
+    @property
+    def alive(self) -> bool:
+        with self._lock:
+            return not self._dead and not self._stop.is_set()
+
+    def stats(self) -> dict:
+        with self._lock:
+            pending = len(self._pending)
+            lat = (np.sort(np.asarray(self._intertoken_ms, np.float64))
+                   if self._intertoken_ms else np.zeros((0,)))
+        pick = (lambda q: float(lat[min(len(lat) - 1, int(q * len(lat)))])
+                if len(lat) else None)
+        return {
+            "replica": self.replica,
+            "alive": self.alive,
+            "tokens": self.n_tokens,
+            "steps": self.n_steps,
+            "admitted": self.n_admitted,
+            "evicted": self.n_evicted,
+            "overloaded": self.n_overloaded,
+            "step_errors": self.n_step_errors,
+            "shared_steps": self.shared_steps,
+            "max_concurrent": self.max_concurrent,
+            "active": len(self._active),
+            "pending": pending,
+            "free_pages": self.session.pool.free_pages,
+            "intertoken_ms": {"p50": pick(0.50), "p99": pick(0.99),
+                              "count": len(lat)},
+            "compiles": dict(self.session.compiles),
+        }
+
+    # -- client side ----------------------------------------------------
+
+    def generate(self, prompt, max_new: int | None = None) -> list[int]:
+        """Greedy-decode up to ``max_new`` tokens after ``prompt``;
+        blocks until the sequence finishes.  Raises
+        :class:`Overloaded` on admission rejection or re-raises the
+        step error that consumed this request."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        max_new = int(max_new if max_new is not None
+                      else self.policy.max_new_cap)
+        max_new = min(max_new, self.policy.max_new_cap)
+        if prompt.shape[0] < 1 or max_new < 1:
+            raise ValueError("need a non-empty prompt and max_new >= 1")
+        if prompt.shape[0] > self.session.max_prompt:
+            raise ValueError(
+                f"prompt length {prompt.shape[0]} exceeds the largest "
+                f"prefill bucket {self.session.max_prompt}")
+        if prompt.shape[0] + max_new > self.session.max_len:
+            raise ValueError(
+                f"prompt+max_new {prompt.shape[0] + max_new} exceeds "
+                f"the model's max_len {self.session.max_len} "
+                "(positional table)")
+        req = _GenRequest(prompt, max_new)
+        with self._cond:
+            if self._dead or self._stop.is_set():
+                self.n_overloaded += 1
+                monitor.inc("decode/overloaded_total",
+                            replica=self.replica)
+                raise Overloaded(
+                    f"decode replica {self.replica} is not serving")
+            if len(self._pending) >= self.policy.max_pending:
+                self.n_overloaded += 1
+                monitor.inc("decode/overloaded_total",
+                            replica=self.replica)
+                raise Overloaded(
+                    f"decode replica {self.replica} admission queue is "
+                    f"full ({self.policy.max_pending} pending); "
+                    "rejecting instead of queueing unboundedly")
+            self._pending.append(req)
+            monitor.set_gauge("decode/pending", len(self._pending),
+                              replica=self.replica)
+            self._cond.notify_all()
+        if not req.done.wait(self.policy.submit_timeout_s):
+            with self._cond:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    # already admitted: the scheduler evicts it at the
+                    # next step boundary via the cancelled flag
+                    req.cancelled = True
+            raise TimeoutError(
+                f"generate timed out after "
+                f"{self.policy.submit_timeout_s}s on decode replica "
+                f"{self.replica}")
+        if req.error is not None:
+            raise req.error
+        return req.out
+
+    # -- scheduler thread ----------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._admit()
+            if not self._active:
+                with self._cond:
+                    if not self._pending and not self._stop.is_set():
+                        self._cond.wait(0.25)
+                        monitor.set_gauge("serving/replica_heartbeat",
+                                          time.time(),
+                                          replica=self.replica)
+                continue
+            self._step()
+        self._drain()
+
+    def _take_pending(self) -> _GenRequest | None:
+        with self._cond:
+            req = self._pending.popleft() if self._pending else None
+            monitor.set_gauge("decode/pending", len(self._pending),
+                              replica=self.replica)
+            return req
+
+    def _admit(self) -> None:
+        """Admit pending prompts into free slots — every iteration, so
+        the oldest waiter's deadline is one decode step away."""
+        while (len(self._active) < self.session.cfg.max_seqs
+                and self.session.can_admit()
+                and not self._stop.is_set()):
+            req = self._take_pending()
+            if req is None:
+                return
+            if req.cancelled:
+                continue
+            t0 = time.monotonic()
+            try:
+                seq, logits = self.session.admit(req.prompt)
+            except Exception as e:
+                if isinstance(e, ValueError):
+                    # a bad request must not kill the replica
+                    self._fail_requests([req], e)
+                    continue
+                self._abort_inflight(e, extra=[req])
+                return
+            monitor.observe("decode/prefill_ms",
+                            (time.monotonic() - t0) * 1e3,
+                            replica=self.replica)
+            self.n_admitted += 1
+            monitor.inc("decode/admitted_total", replica=self.replica)
+            self._active.append((req, seq))
+            self.max_concurrent = max(self.max_concurrent,
+                                      len(self._active))
+            self._emit_token(req, int(np.argmax(logits)))
+            self._evict_finished()
+        monitor.set_gauge("decode/cache_occupancy",
+                          self.session.pool.used_fraction,
+                          replica=self.replica)
+        monitor.set_gauge("decode/active_seqs", len(self._active),
+                          replica=self.replica)
+
+    def _step(self) -> None:
+        self._steps += 1
+        t0 = time.monotonic()
+        reqs = [r for r, _ in self._active]
+        seqs = [s for _, s in self._active]
+        tokens = np.asarray(
+            [r.out[-1] if r.out else int(r.prompt[-1]) for r in reqs],
+            np.int32)
+        try:
+            faults.fire("decode_step", replica=self.replica,
+                        step=self._steps)
+            logits = self.session.decode(seqs, tokens)
+        except Exception as e:
+            self._abort_inflight(e)
+            return
+        self.n_steps += 1
+        monitor.inc("decode/steps_total", replica=self.replica)
+        monitor.observe("decode/step_ms",
+                        (time.monotonic() - t0) * 1e3,
+                        replica=self.replica)
+        monitor.set_gauge("serving/replica_heartbeat", time.time(),
+                          replica=self.replica)
+        if len(self._active) >= 2:
+            self.shared_steps += 1
+        for i, (req, _) in enumerate(self._active):
+            self._emit_token(req, int(np.argmax(logits[i])))
+        self._evict_finished()
+
+    def _emit_token(self, req: _GenRequest, token: int) -> None:
+        now = time.monotonic()
+        first = not req.out
+        req.out.append(token)
+        self.n_tokens += 1
+        monitor.inc("decode/tokens_total", replica=self.replica)
+        if first:
+            # the first token is prefill's output: its latency is
+            # queue wait + prefill (decode/prefill_ms covers it), not
+            # an inter-token gap — recording it would let admission
+            # queueing contaminate the SLO histogram under overload
+            req.t_last = now
+            return
+        dt_ms = (now - req.t_last) * 1e3
+        req.t_last = now
+        with self._lock:  # stats() iterates this deque concurrently
+            self._intertoken_ms.append(dt_ms)
+        monitor.observe("decode/intertoken_ms", dt_ms,
+                        replica=self.replica)
+
+    def _finished(self, req: _GenRequest) -> bool:
+        if req.cancelled or len(req.out) >= req.max_new:
+            return True
+        eos = self.policy.eos_token
+        return eos is not None and bool(req.out) and req.out[-1] == eos
+
+    def _evict_finished(self) -> None:
+        keep = []
+        for req, seq in self._active:
+            if self._finished(req):
+                self.session.release(seq)
+                self.n_evicted += 1
+                monitor.inc("decode/evictions_total",
+                            replica=self.replica)
+                req.done.set()
+            else:
+                keep.append((req, seq))
+        self._active = keep
+        monitor.set_gauge("decode/active_seqs", len(self._active),
+                          replica=self.replica)
+        monitor.set_gauge("decode/cache_occupancy",
+                          self.session.pool.used_fraction,
+                          replica=self.replica)
+
+    # -- failure plumbing ----------------------------------------------
+
+    def _abort_inflight(self, err: BaseException,
+                        extra: list | None = None) -> None:
+        """A prefill/decode failure poisons the replica's device state
+        (donated pool buffers may be consumed): fail EVERY in-flight
+        stream and return its pages BEFORE the on_error hook runs —
+        ``DecodeSession.reset_cache``'s precondition — then restart
+        from the export or mark the replica dead.  ``extra`` carries a
+        request that failed before it owned a sequence (the admit
+        path)."""
+        self.n_step_errors += 1
+        monitor.inc("decode/step_errors_total", replica=self.replica)
+        for _, seq in self._active:
+            self.session.release(seq)
+        failed, self._active = [r for r, _ in self._active], []
+        self._fail_requests(list(extra or ()) + failed, err)
+        monitor.set_gauge("decode/active_seqs", 0,
+                          replica=self.replica)
+        if self._on_error is None or not self._on_error(err):
+            self._mark_dead()
+
+    def _fail_requests(self, reqs, err: BaseException) -> None:
+        for r in reqs:
+            if not r.done.is_set():
+                r.error = err
+                r.done.set()
+
+    def _mark_dead(self) -> None:
+        with self._cond:
+            self._dead = True
+            self._cond.notify_all()
+        self._fail_pending(Overloaded(
+            f"decode replica {self.replica} died "
+            "(restart budget exhausted)"))
+
+    def _fail_pending(self, err: BaseException) -> None:
+        with self._cond:
+            pending, self._pending = list(self._pending), deque()
+        self._fail_requests(pending, err)
+
+    def _drain(self) -> None:
+        """Stop path: evict everything, fail what was still running."""
+        err = Overloaded(
+            f"decode replica {self.replica} is shutting down")
+        for req, seq in self._active:
+            self.session.release(seq)
+            self._fail_requests([req], err)
+        self._active = []
+        self._fail_pending(err)
+
+
+class DecodeReplica:
+    """One decode session + continuous batcher under the same
+    restart-from-export supervision as ``serving/server.py Replica``:
+    a step failure fails that step's sequences, then the replica
+    reloads VERIFIED bytes from the export (budget ``max_restarts``)
+    with a fresh page pool; budget exhausted = replica lost, the
+    server routes around it."""
+
+    def __init__(self, idx: int, export_dir: str, model, loaded,
+                 policy: DecodePolicy | None = None,
+                 max_restarts: int = 2, page_size: int = 16,
+                 pages_per_seq: int = 8, max_seqs: int = 8,
+                 prefill_buckets: tuple[int, ...] | None = None,
+                 donate: bool = True):
+        from theanompi_tpu.decode.session import DecodeSession
+
+        self.idx = int(idx)
+        self.export_dir = export_dir
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.session = DecodeSession(
+            model, params=loaded.params, version=loaded.version,
+            page_size=page_size, pages_per_seq=pages_per_seq,
+            max_seqs=max_seqs, prefill_buckets=prefill_buckets,
+            donate=donate)
+        self.batcher = ContinuousBatcher(
+            self.session, policy, replica=self.idx,
+            on_error=self._on_step_error)
+
+    @property
+    def alive(self) -> bool:
+        return self.batcher.alive
+
+    def generate(self, prompt, max_new: int | None = None) -> list[int]:
+        return self.batcher.generate(prompt, max_new)
+
+    def swap(self, version: int, params, model_state=None) -> None:
+        self.session.swap(version, params, model_state)
+
+    def _on_step_error(self, exc: BaseException) -> bool:
+        from theanompi_tpu.serving.export import load_export
+
+        self.restarts += 1
+        monitor.inc("serving/replica_restarts_total", replica=self.idx)
+        if self.restarts > self.max_restarts:
+            print(f"[decode] replica {self.idx} exhausted "
+                  f"{self.max_restarts} restarts "
+                  f"({type(exc).__name__}: {exc}); marking it lost",
+                  flush=True)
+            return False
+        try:
+            # the version BEING SERVED, not the newest publish: a
+            # restart must never become a side door past the reload
+            # watcher's IncompatibleExport refusal (serving/server.py
+            # Replica._on_batch_error has the same pin)
+            loaded = load_export(self.export_dir,
+                                 version=self.session.version)
+        except Exception as e:
+            print(f"[decode] replica {self.idx} restart-from-export "
+                  f"failed ({type(e).__name__}: {e}); marking it lost",
+                  flush=True)
+            return False
+        self.session.swap(loaded.version, loaded.params)
+        # the failed step may have consumed the donated pool buffers —
+        # restart on fresh pages (active sequences were already failed)
+        self.session.reset_cache()
+        print(f"[decode] replica {self.idx} restarted from export "
+              f"v{loaded.version} after {type(exc).__name__} "
+              f"(restart {self.restarts}/{self.max_restarts})",
+              flush=True)
+        return True

@@ -1,0 +1,299 @@
+"""DecodeSession — jitted prefill/decode programs over one page pool.
+
+The autoregressive counterpart of ``serving/export.py
+InferenceSession``: one session owns the replica's page pool
+(decode/kvcache.py) and exactly TWO families of compiled programs,
+keyed by the bucket discipline that keeps steady state recompile-free:
+
+* **prefill** — one program per padded PROMPT-LENGTH bucket
+  (``prefill_buckets``, powers of two): the whole prompt runs through
+  the sliding-window full forward (decode/model.py) and its last
+  ``window`` positions' K/V scatter into the sequence's freshly
+  allocated pages; returns the last real token's logits (the first
+  decode step for free).
+* **decode** — one program per DECODE-BATCH bucket
+  (``serving.batcher.default_buckets(max_seqs)``): the active
+  sequences are packed to the front, padded to the bucket with
+  inactive rows (whose page writes route to a dropped id), and one
+  token advances for every live sequence in a single device step over
+  a fixed-shape page pool.
+
+Both donate the pool buffers (``donate_argnums``) — the cache updates
+in place, XLA never holds two pools.  Both count their own traces by a
+plain Python increment INSIDE the traced body (re-tracing re-runs the
+Python), which is the compile-counter tests/test_decode.py pins at
+"steady state = zero new compiles" — the same trick as
+``exchange/traces_total``.
+
+Host-side sequence state (page rows, lengths, the free-page pool) is
+owned by the replica's single scheduler thread
+(decode/scheduler.py) — no locks by design.  ``swap`` (hot reload) is
+the only cross-thread entry and uses the ``InferenceSession`` pattern:
+one published ``(version, params)`` tuple, snapshot-read per step, so
+an in-flight step finishes on the params it started with.
+
+Quantized params (serving/export.py ``weight_dtype``) work in both
+modes: pass a dequantized tree (``load_export(..., dequantize=True)``,
+the default) or the raw quantized tree — ``dequantize_tree`` runs
+inside the jitted body, so int8 weights stay int8 on device and
+rematerialize per step (the replicas-per-chip lever).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.analysis.lockgraph import make_lock
+from theanompi_tpu.decode import kvcache
+from theanompi_tpu.decode.model import (
+    decode_block,
+    embed_tokens,
+    final_logits,
+    full_forward,
+)
+from theanompi_tpu.serving.batcher import default_buckets, pick_bucket
+from theanompi_tpu.serving.export import dequantize_tree
+
+
+def default_prefill_buckets(max_len: int,
+                            cap: int = 512) -> tuple[int, ...]:
+    """Powers of two from 8 up to min(cap, max_len) — a handful of
+    prompt shapes covering every admissible prompt."""
+    out = []
+    b = 8
+    while b <= min(int(cap), int(max_len)):
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+class _Seq:
+    """One live sequence's host-side cache bookkeeping (scheduler-
+    thread owned)."""
+
+    __slots__ = ("page_row", "length")
+
+    def __init__(self, page_row: np.ndarray, length: int):
+        self.page_row = page_row
+        self.length = int(length)
+
+
+class DecodeSession:
+    """Paged-KV token generation for one exported transformer."""
+
+    def __init__(self, model, params=None, version: int = 0,
+                 page_size: int = 16, pages_per_seq: int = 8,
+                 max_seqs: int = 8,
+                 prefill_buckets: tuple[int, ...] | None = None,
+                 donate: bool = True):
+        module = model.module
+        for field in ("n_layers", "n_heads", "d_model", "max_len"):
+            if not hasattr(module, field):
+                raise ValueError(
+                    f"{type(module).__name__} is not a decode-capable "
+                    f"transformer (missing {field}); decode serves "
+                    "the TransformerLM family only")
+        self.model = model
+        self.n_layers = int(module.n_layers)
+        self.n_heads = int(module.n_heads)
+        self.d_model = int(module.d_model)
+        self.max_len = int(module.max_len)
+        self.dtype = jnp.dtype(module.dtype)
+        self.cfg = kvcache.CacheConfig(
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            d_head=self.d_model // self.n_heads, page_size=page_size,
+            pages_per_seq=pages_per_seq, max_seqs=max_seqs,
+            dtype=self.dtype.name)
+        self.window = self.cfg.window
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in (prefill_buckets or
+                             default_prefill_buckets(self.max_len)))))
+        if not self.prefill_buckets \
+                or self.prefill_buckets[0] < 1 \
+                or self.prefill_buckets[-1] > self.max_len:
+            raise ValueError(
+                f"prefill buckets {self.prefill_buckets} must be >= 1 "
+                f"and <= max_len {self.max_len}")
+        self.decode_buckets = default_buckets(int(max_seqs))
+        self.max_prompt = self.prefill_buckets[-1]
+
+        params = params if params is not None else model.state.params
+        # one-tuple publish, snapshot-read (InferenceSession pattern):
+        # an in-flight prefill/decode finishes on the params it read
+        self._live = (int(version), self._place(params))
+        self._swap_lock = make_lock("DecodeSession._swap_lock")
+
+        # scheduler-thread-owned device + host cache state
+        self._ck, self._cv = kvcache.init_pages(self.cfg)
+        self.pool = kvcache.PagePool(self.cfg)
+
+        #: traces per program family — incremented at TRACE time inside
+        #: the jitted bodies; the steady-state-zero-recompiles pin
+        self.compiles = {"prefill": 0, "decode": 0}
+        self._prefill = jax.jit(
+            self._prefill_fn, donate_argnums=(1, 2) if donate else ())
+        self._decode = jax.jit(
+            self._decode_fn, donate_argnums=(1, 2) if donate else ())
+
+    # -- params ---------------------------------------------------------
+
+    @staticmethod
+    def _place(tree):
+        return jax.tree.map(jnp.asarray, tree)
+
+    @property
+    def version(self) -> int:
+        return self._live[0]
+
+    def swap(self, version: int, params, model_state=None) -> bool:
+        """Publish new weights (hot reload / restart-from-export).
+        Monotonic like ``InferenceSession.swap``; the cache is NOT
+        reset — in-flight sequences continue, their next tokens come
+        from the new weights (docs/SERVING.md decode reload note).
+        ``model_state`` is accepted for Replica-interface parity; the
+        LM family has none."""
+        del model_state
+        with self._swap_lock:
+            if int(version) < self._live[0]:
+                return False
+            self._live = (int(version), self._place(params))
+            return True
+
+    # -- jitted programs ------------------------------------------------
+
+    def _prefill_fn(self, params, k_pages, v_pages, tokens, length,
+                    page_row):
+        self.compiles["prefill"] += 1      # trace-time counter
+        p = dequantize_tree(params)
+        logits, ks, vs = full_forward(p, tokens, self.n_layers,
+                                      self.n_heads, self.dtype,
+                                      window=self.window)
+        ps, pps = self.cfg.page_size, self.cfg.pages_per_seq
+        hd = (self.n_heads, self.cfg.d_head)
+        ring_k = jnp.stack([
+            kvcache.ring_from_prompt(k[0], length, self.window)
+            for k in ks]).reshape(self.n_layers, pps, ps, *hd)
+        ring_v = jnp.stack([
+            kvcache.ring_from_prompt(v[0], length, self.window)
+            for v in vs]).reshape(self.n_layers, pps, ps, *hd)
+        k_pages = k_pages.at[:, page_row].set(ring_k, mode="drop")
+        v_pages = v_pages.at[:, page_row].set(ring_v, mode="drop")
+        return k_pages, v_pages, logits[0, length - 1]
+
+    def _decode_fn(self, params, k_pages, v_pages, tokens, lengths,
+                   page_rows, active):
+        self.compiles["decode"] += 1       # trace-time counter
+        p = dequantize_tree(params)
+        pos = jnp.minimum(lengths, self.max_len - 1)
+        x = embed_tokens(p, tokens, pos)[:, None, :].astype(self.dtype)
+        mask = kvcache.cache_mask(lengths, self.window)
+        k_new, v_new = [], []
+        for layer in range(self.n_layers):
+            kc = kvcache.gather_layer(k_pages[layer], page_rows)
+            vc = kvcache.gather_layer(v_pages[layer], page_rows)
+            x, kn, vn = decode_block(p[f"Block_{layer}"], x, kc, vc,
+                                     mask, self.n_heads, self.dtype)
+            k_new.append(kn)
+            v_new.append(vn)
+        # all writes are for THIS token, so they land after every
+        # layer's (pre-write) gather — one batched scatter per pool
+        k_pages = kvcache.write_token_all(k_pages, page_rows, lengths,
+                                          active, jnp.stack(k_new))
+        v_pages = kvcache.write_token_all(v_pages, page_rows, lengths,
+                                          active, jnp.stack(v_new))
+        return k_pages, v_pages, final_logits(p, x, self.dtype)[:, 0]
+
+    # -- scheduler-facing host API (single scheduler thread) ------------
+
+    def can_admit(self) -> bool:
+        return self.pool.free_pages >= self.cfg.pages_per_seq
+
+    def admit(self, prompt: np.ndarray) -> tuple[_Seq, np.ndarray]:
+        """Allocate pages, prefill the prompt, return the new sequence
+        and the last real token's f32 logits (V,)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        t = prompt.shape[0]
+        if not 1 <= t <= self.max_prompt:
+            raise ValueError(
+                f"prompt length {t} outside [1, {self.max_prompt}] "
+                "(largest prefill bucket)")
+        page_row = self.pool.alloc_seq()
+        if page_row is None:
+            raise RuntimeError("admit() without free pages — the "
+                               "scheduler must check can_admit() first")
+        bucket = pick_bucket(t, self.prefill_buckets)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :t] = prompt
+        _, params = self._live          # one-read snapshot
+        try:
+            self._ck, self._cv, logits = self._prefill(
+                params, self._ck, self._cv, jnp.asarray(tokens),
+                jnp.int32(t), jnp.asarray(page_row))
+        except Exception:
+            # a failed prefill must not leak the sequence's pages
+            self.pool.free_seq(page_row)
+            raise
+        return _Seq(page_row, t), np.asarray(jax.device_get(logits))
+
+    def decode(self, seqs: list[_Seq],
+               tokens: np.ndarray) -> np.ndarray:
+        """One decode step for every sequence in ``seqs`` (their
+        freshly sampled ``tokens``, one each) — packed and padded to a
+        decode bucket.  Returns f32 logits (len(seqs), V) for the NEXT
+        token; each sequence's length advances by one."""
+        n = len(seqs)
+        if not 1 <= n <= self.cfg.max_seqs:
+            raise ValueError(f"{n} sequences outside "
+                             f"[1, {self.cfg.max_seqs}]")
+        bucket = pick_bucket(n, self.decode_buckets)
+        toks = np.zeros((bucket,), np.int32)
+        lens = np.zeros((bucket,), np.int32)
+        rows = np.full((bucket, self.cfg.pages_per_seq),
+                       self.cfg.n_pages, np.int32)
+        active = np.zeros((bucket,), bool)
+        for i, s in enumerate(seqs):
+            toks[i] = tokens[i]
+            lens[i] = s.length
+            rows[i] = s.page_row
+            active[i] = True
+        _, params = self._live          # one-read snapshot
+        self._ck, self._cv, logits = self._decode(
+            params, self._ck, self._cv, jnp.asarray(toks),
+            jnp.asarray(lens), jnp.asarray(rows), jnp.asarray(active))
+        for s in seqs:
+            s.length += 1
+        return np.asarray(jax.device_get(logits))[:n]
+
+    def release(self, seq: _Seq) -> None:
+        self.pool.free_seq(seq.page_row)
+
+    def reset_cache(self) -> None:
+        """Fresh page pool + allocator (restart-from-export path): a
+        failed step may have consumed the donated pool buffers, so the
+        replica restarts from zeroed pages — live sequences were
+        already failed and released by the scheduler."""
+        self._ck, self._cv = kvcache.init_pages(self.cfg)
+        self.pool = kvcache.PagePool(self.cfg)
+
+    def warmup(self) -> None:
+        """Compile the smallest prefill and decode programs before the
+        port binds (the rest compile once at first use — still 'once
+        ever' per bucket, which is what the counter pins)."""
+        _, params = self._live
+        drop_row = np.full((self.cfg.pages_per_seq,), self.cfg.n_pages,
+                           np.int32)
+        tokens = np.zeros((1, self.prefill_buckets[0]), np.int32)
+        self._ck, self._cv, _ = self._prefill(
+            params, self._ck, self._cv, jnp.asarray(tokens),
+            jnp.int32(1), jnp.asarray(drop_row))
+        bucket = self.decode_buckets[0]
+        rows = np.full((bucket, self.cfg.pages_per_seq),
+                       self.cfg.n_pages, np.int32)
+        self._ck, self._cv, _ = self._decode(
+            params, self._ck, self._cv,
+            jnp.zeros((bucket,), jnp.int32),
+            jnp.zeros((bucket,), jnp.int32), jnp.asarray(rows),
+            jnp.zeros((bucket,), bool))

@@ -202,6 +202,30 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
     p.add_argument("--reload-poll-s", type=float, default=1.0,
                    help="SERVE: export-dir poll interval for hot "
                         "reload (0 disables the watcher)")
+    p.add_argument("--decode", action="store_true",
+                   help="SERVE: autoregressive decode mode "
+                        "(theanompi_tpu/decode, docs/SERVING.md): "
+                        "paged KV-cache + continuous batching over a "
+                        "TransformerLM export; clients use the "
+                        "GENERATE wire op (InferenceClient.generate)")
+    p.add_argument("--decode-page-size", type=int, default=16,
+                   help="SERVE --decode: tokens per KV-cache page")
+    p.add_argument("--decode-pages-per-seq", type=int, default=8,
+                   help="SERVE --decode: pages per live sequence — "
+                        "page_size x pages_per_seq is the attention "
+                        "window; older tokens ring-evict")
+    p.add_argument("--decode-max-seqs", type=int, default=8,
+                   help="SERVE --decode: max concurrently-decoding "
+                        "sequences per replica")
+    p.add_argument("--decode-max-pending", type=int, default=32,
+                   help="SERVE --decode: admission bound — pending "
+                        "prompts beyond this are rejected with "
+                        "Overloaded")
+    p.add_argument("--decode-prefill-buckets", default=None,
+                   metavar="N,N,...",
+                   help="SERVE --decode: padded prompt-length buckets "
+                        "(default powers of two up to min(512, "
+                        "max_len))")
     p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
                    help="persistent XLA compilation cache "
                         "(utils/helper_funcs.enable_compilation_cache): "
@@ -348,6 +372,11 @@ def _run(args, multihost: bool) -> int:
     from theanompi_tpu.utils.helper_funcs import enable_compilation_cache
 
     enable_compilation_cache(cache_dir)
+    if args.decode and args.rule != "SERVE":
+        # silently ignoring the flag would let the user believe the
+        # decode plane is live when it is not
+        raise SystemExit("--decode is a SERVE option "
+                         "(tmlocal SERVE --decode ...)")
     if args.rule == "SERVE":
         # inference mode (theanompi_tpu/serving): no rule session, no
         # model resolution — the export's metadata names the model
@@ -357,10 +386,15 @@ def _run(args, multihost: bool) -> int:
         if not args.export_dir:
             raise SystemExit("SERVE requires --export-dir (see "
                              "serving/export.py export_model)")
-        from theanompi_tpu.serving.server import DEFAULT_PORT, serve_main
+        from theanompi_tpu.serving.server import (
+            DEFAULT_PORT,
+            decode_opts_from_args,
+            serve_main,
+        )
 
         buckets = (tuple(int(b) for b in args.serve_buckets.split(","))
                    if args.serve_buckets else None)
+        decode_opts = decode_opts_from_args(args)
         return serve_main(
             args.export_dir, host=args.serve_host,
             port=args.port if args.port is not None else DEFAULT_PORT,
@@ -369,7 +403,8 @@ def _run(args, multihost: bool) -> int:
             max_queue=args.max_queue,
             max_restarts=(2 if args.max_restarts is None
                           else args.max_restarts),
-            reload_poll_s=args.reload_poll_s)
+            reload_poll_s=args.reload_poll_s,
+            decode=args.decode, decode_opts=decode_opts)
     if multihost:
         import jax
 

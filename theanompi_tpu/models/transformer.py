@@ -179,6 +179,10 @@ class TransformerLM(TpuModel):
     #: mesh axis the TIME dimension is sharded over inside the step
     #: (None = full attention; the TP variant sets None)
     seq_axis: str | None = AXIS_SEQ
+    #: exports of this family may serve the autoregressive decode path
+    #: (theanompi_tpu/decode — single-flax-module param tree; the
+    #: PP/MoE variants assemble diverging trees and stay eval-only)
+    decode_capable = True
 
     @classmethod
     def default_config(cls) -> ModelConfig:

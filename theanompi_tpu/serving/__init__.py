@@ -27,12 +27,16 @@ from theanompi_tpu.serving.batcher import (
     pick_bucket,
 )
 from theanompi_tpu.serving.export import (
+    IncompatibleExport,
     InferenceSession,
     LoadedExport,
     build_model_from_meta,
+    dequantize_tree,
+    export_incompatibility,
     export_model,
     latest_export_version,
     load_export,
+    quantize_tree,
 )
 from theanompi_tpu.serving.server import (
     DEFAULT_PORT,
@@ -45,8 +49,9 @@ from theanompi_tpu.serving.server import (
 
 __all__ = [
     "BatchPolicy", "DynamicBatcher", "Overloaded", "default_buckets",
-    "pick_bucket", "InferenceSession", "LoadedExport",
-    "build_model_from_meta", "export_model", "latest_export_version",
-    "load_export", "DEFAULT_PORT", "InferenceClient", "InferenceServer",
-    "Replica", "serve", "serve_main",
+    "pick_bucket", "IncompatibleExport", "InferenceSession",
+    "LoadedExport", "build_model_from_meta", "dequantize_tree",
+    "export_incompatibility", "export_model", "latest_export_version",
+    "load_export", "quantize_tree", "DEFAULT_PORT", "InferenceClient",
+    "InferenceServer", "Replica", "serve", "serve_main",
 ]

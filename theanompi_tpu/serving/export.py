@@ -34,13 +34,139 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from theanompi_tpu.parallel.wire import BF16
 from theanompi_tpu.utils.checkpoint import Checkpointer
 
 PyTree = Any
 
+#: export weight storage dtypes (docs/SERVING.md "Quantized exports"):
+#: 'bf16' halves the artifact/device bytes (the wire-v2 dtype reused at
+#: rest), 'int8' quarters them with a per-output-channel scale
+WEIGHT_DTYPES = ("f32", "bf16", "int8")
+
+
+class IncompatibleExport(RuntimeError):
+    """A published export the live server must NOT hot-swap in:
+    different model, sample shape, weight dtype, or decode capability
+    than what is serving.  Typed (rides the wire ``err`` prefix like
+    :class:`~theanompi_tpu.serving.batcher.Overloaded`) so the reload
+    watcher refuses and keeps serving instead of crashing a replica
+    mid-swap."""
+
 
 def meta_path(export_dir: str, version: int) -> str:
     return os.path.join(export_dir, f"export_meta_{int(version)}.json")
+
+
+# ---------------------------------------------------------------------------
+# Weight quantization (bf16 / int8 weight-only)
+# ---------------------------------------------------------------------------
+
+#: structural marker of one int8-quantized leaf: a dict holding exactly
+#: the quantized bytes and their per-output-channel f32 scale
+_INT8_KEYS = frozenset({"int8_data", "int8_scale"})
+
+
+def is_quantized_leaf(node: Any) -> bool:
+    return isinstance(node, dict) and set(node.keys()) == _INT8_KEYS
+
+
+def quantize_tree(params: PyTree, weight_dtype: str) -> PyTree:
+    """Quantize a HOST param tree for storage (export side).
+
+    Weight-only, matmul-applied tensors only: float32 leaves of
+    ndim >= 2 (kernels, embeddings).  Biases, norms and other 1-D
+    state stay f32 — their bytes are noise and their precision is not.
+
+    * ``bf16``: the wire-v2 discipline at rest — bfloat16 keeps f32's
+      exponent range, costs 16 of 24 mantissa bits (error-bound pinned
+      in tests/test_decode.py).
+    * ``int8``: symmetric per-output-channel scale (amax over all axes
+      but the last / 127); dequantized as ``data * scale`` either at
+      load or inside the jitted step (``dequantize_tree``).
+    """
+    if weight_dtype not in WEIGHT_DTYPES:
+        raise ValueError(f"weight_dtype must be one of {WEIGHT_DTYPES}, "
+                         f"got {weight_dtype!r}")
+    if weight_dtype == "f32":
+        return params
+    if BF16 is None:  # pragma: no cover - ml_dtypes ships with jax
+        raise RuntimeError("quantized exports need ml_dtypes")
+
+    def q(leaf):
+        a = np.asarray(leaf)
+        if a.dtype != np.float32 or a.ndim < 2:
+            return a
+        if weight_dtype == "bf16":
+            return a.astype(BF16)
+        amax = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)),
+                      keepdims=True)
+        scale = (np.where(amax > 0, amax, 1.0) / 127.0).astype(
+            np.float32)
+        data = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+        return {"int8_data": data, "int8_scale": scale}
+
+    return jax.tree.map(q, params)
+
+
+def dequantize_tree(tree: PyTree, upcast_bf16: bool = False) -> PyTree:
+    """Collapse quantized nodes back to float arrays.
+
+    jit-safe (pure ``astype``/multiply — the decode session calls it
+    INSIDE the traced step so int8 weights stay int8 on device,
+    docs/SERVING.md).  ``upcast_bf16=True`` additionally converts
+    bf16-stored leaves to f32 — the dequantize-ON-LOAD path
+    (``load_export`` default), restoring exactly what a non-quantized
+    session expects.
+    """
+    if is_quantized_leaf(tree):
+        return tree["int8_data"].astype("float32") * tree["int8_scale"]
+    if isinstance(tree, dict):
+        return {k: dequantize_tree(v, upcast_bf16)
+                for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(dequantize_tree(v, upcast_bf16)
+                          for v in tree)
+    if upcast_bf16 and BF16 is not None \
+            and getattr(tree, "dtype", None) == BF16:
+        return np.asarray(tree, np.float32)
+    return tree
+
+
+def export_incompatibility(live_meta: dict, new_meta: dict) -> str | None:
+    """Why a newly published export must NOT be hot-swapped into a
+    server currently serving ``live_meta`` — None when compatible.
+    The refusal contract the reload watcher enforces (typed
+    :class:`IncompatibleExport`, docs/SERVING.md)."""
+    for key in ("modelfile", "modelclass"):
+        if live_meta.get(key) != new_meta.get(key):
+            return (f"{key} changed "
+                    f"{live_meta.get(key)!r} -> {new_meta.get(key)!r}")
+    if list(live_meta.get("sample_shape") or []) != \
+            list(new_meta.get("sample_shape") or []):
+        return (f"sample_shape changed "
+                f"{live_meta.get('sample_shape')} -> "
+                f"{new_meta.get('sample_shape')}")
+    if (live_meta.get("net") or {}) != (new_meta.get("net") or {}):
+        # constructor dims (the transformer family's vocab/layers/
+        # d_model/heads): a resized export's arrays cannot adopt into
+        # sessions built around the live module — swapping it in would
+        # crash-loop every replica, the exact failure refusal exists
+        # to prevent
+        return (f"net dims changed {live_meta.get('net')} -> "
+                f"{new_meta.get('net')}")
+    live_wd = live_meta.get("weight_dtype") or "f32"
+    new_wd = new_meta.get("weight_dtype") or "f32"
+    if live_wd != new_wd:
+        return (f"weight_dtype changed {live_wd!r} -> {new_wd!r} "
+                "(a live replica's compiled programs and memory plan "
+                "assume the serving dtype; restart the server to "
+                "change it)")
+    if bool(live_meta.get("decode")) != bool(new_meta.get("decode")):
+        return ("decode capability changed "
+                f"{bool(live_meta.get('decode'))} -> "
+                f"{bool(new_meta.get('decode'))}")
+    return None
 
 
 def _host(tree: PyTree) -> PyTree:
@@ -58,18 +184,26 @@ def _sample_dtype(model) -> str:
 
 
 def export_model(model, export_dir: str, version: int | None = None,
-                 max_to_keep: int = 5) -> int:
+                 max_to_keep: int = 5, weight_dtype: str = "f32") -> int:
     """Write one export version from a live model; returns the version.
 
     ``version`` defaults to the model's current epoch.  Re-exporting
     an existing version is refused (Orbax would silently skip the
     write, blessing stale files under a new manifest) — bump the
     version instead; the serving reload protocol is strictly
-    monotonic."""
+    monotonic.
+
+    ``weight_dtype`` selects the stored precision of matmul-applied
+    weights (``quantize_tree``): 'bf16' halves and 'int8' quarters the
+    artifact and (with on-the-fly dequant) device bytes — the
+    replicas-per-chip lever.  The dtype is recorded in the meta
+    sidecar; a live server refuses to hot-swap across a dtype change
+    (``export_incompatibility``)."""
     if version is None:
         version = int(model.current_epoch)
     version = int(version)
-    payload = {"params": _host(model.state.params),
+    payload = {"params": quantize_tree(_host(model.state.params),
+                                       weight_dtype),
                "model_state": _host(model.state.model_state)}
     # sync save: when export_model returns, files AND manifest are on
     # disk — the atomic publish a watching server's poll keys off
@@ -94,6 +228,16 @@ def export_model(model, export_dir: str, version: int | None = None,
         "sample_shape": list(model.data.sample_shape),
         "sample_dtype": _sample_dtype(model),
         "n_classes": getattr(model.data, "n_classes", None),
+        # constructor kwargs beyond ModelConfig (the transformer
+        # family's vocab/seq_len/layers/dims) — without these a
+        # CLI-resized export would rebuild at DEFAULT dims and fail to
+        # adopt the restored arrays
+        "net": getattr(model, "_net_cfg", None),
+        "weight_dtype": weight_dtype,
+        # decode capability: may this export serve the autoregressive
+        # path (theanompi_tpu/decode)?  The hot-reload watcher refuses
+        # to swap a capability change into a live replica
+        "decode": bool(getattr(model, "decode_capable", False)),
         "created": time.time(),
     }
     path = meta_path(export_dir, version)
@@ -150,9 +294,17 @@ class LoadedExport:
     meta: dict
 
 
-def load_export(export_dir: str, version: int | None = None
-                ) -> LoadedExport:
-    """Read-only verified load (newest verified version by default)."""
+def load_export(export_dir: str, version: int | None = None,
+                dequantize: bool = True) -> LoadedExport:
+    """Read-only verified load (newest verified version by default).
+
+    ``dequantize=True`` (default) collapses any stored bf16/int8
+    weights back to f32 — callers see the same tree regardless of the
+    export's ``weight_dtype``.  Pass ``False`` to keep the quantized
+    leaves (``{int8_data, int8_scale}`` nodes / bf16 arrays) for
+    on-the-fly dequantization inside a jitted step
+    (``dequantize_tree``), which keeps device memory at the quantized
+    footprint."""
     from theanompi_tpu.resilience.recovery import verify_checkpoint
 
     ckpt = Checkpointer(export_dir, read_only=True)
@@ -194,7 +346,10 @@ def load_export(export_dir: str, version: int | None = None
     if os.path.exists(mp):
         with open(mp) as f:
             meta = json.load(f)
-    return LoadedExport(int(v), payload["params"],
+    params = payload["params"]
+    if dequantize:
+        params = dequantize_tree(params, upcast_bf16=True)
+    return LoadedExport(int(v), params,
                         payload.get("model_state") or {}, meta)
 
 
@@ -213,7 +368,11 @@ def build_model_from_meta(meta: dict, mesh=None):
         if k not in fields:
             continue  # a field a newer exporter knew and we don't
         kw[k] = tuple(v) if isinstance(v, list) else v
-    return cls(config=ModelConfig(**kw), mesh=mesh, verbose=False)
+    # net kwargs: the transformer family's constructor dims (vocab,
+    # seq_len, n_layers, ...) — absent for the CNN zoo
+    net = meta.get("net") or {}
+    return cls(config=ModelConfig(**kw), mesh=mesh, verbose=False,
+               **net)
 
 
 class InferenceSession:

@@ -52,8 +52,10 @@ from theanompi_tpu.serving.batcher import (
     Overloaded,
 )
 from theanompi_tpu.serving.export import (
+    IncompatibleExport,
     InferenceSession,
     build_model_from_meta,
+    export_incompatibility,
     latest_export_version,
     load_export,
 )
@@ -86,6 +88,9 @@ class Replica:
     def alive(self) -> bool:
         return self.batcher.alive
 
+    def submit(self, x: np.ndarray) -> np.ndarray:
+        return self.batcher.submit(x)
+
     def _run_batch(self, x: np.ndarray) -> np.ndarray:
         self._steps += 1
         faults.fire("serve_step", replica=self.idx, step=self._steps)
@@ -93,10 +98,14 @@ class Replica:
 
     def _on_batch_error(self, exc: BaseException) -> bool:
         """Supervised recovery (resilience, docs/SERVING.md): reload
-        this replica's arrays from the export — a fresh VERIFIED read,
-        so a batch failure caused by in-memory corruption starts over
-        from known-good bytes.  Returns False (replica lost) once the
-        budget is spent."""
+        this replica's arrays from the export — a fresh read of THE
+        VERSION BEING SERVED, so a batch failure caused by in-memory
+        corruption starts over from known-good bytes.  Pinning the
+        version matters: loading "newest" here would silently swap in
+        a just-published export the reload watcher may have REFUSED as
+        incompatible (weight dtype / net dims) — upgrades go through
+        `check_reload`'s compatibility gate, never through a crash.
+        Returns False (replica lost) once the budget is spent."""
         self.restarts += 1
         monitor.inc("serving/replica_restarts_total", replica=self.idx)
         if self.restarts > self.max_restarts:
@@ -106,7 +115,8 @@ class Replica:
                   flush=True)
             return False
         try:
-            loaded = load_export(self.export_dir)
+            loaded = load_export(self.export_dir,
+                                 version=self.session.version)
         except Exception as e:
             print(f"[serving] replica {self.idx} restart-from-export "
                   f"failed ({type(e).__name__}: {e}); marking it lost",
@@ -134,12 +144,14 @@ class InferenceServer:
                  policy: BatchPolicy | None = None,
                  max_restarts: int = 2, reload_poll_s: float = 1.0,
                  warmup: bool = True, mesh=None, donate: bool = True,
-                 model=None):
+                 model=None, decode: bool = False,
+                 decode_opts: dict | None = None):
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
         self.export_dir = os.path.abspath(export_dir)
         self.policy = policy or BatchPolicy()
         self.reload_poll_s = float(reload_poll_s)
+        self.decode = bool(decode)
         loaded = load_export(self.export_dir)
         # ONE model rebuild (module + config threading) shared by all
         # replicas; each replica jits its own fn over the shared
@@ -149,30 +161,69 @@ class InferenceServer:
         self.model = (model if model is not None
                       else build_model_from_meta(loaded.meta, mesh=mesh))
         self.version = loaded.version        # guarded_by: self._reload_lock
-        self.replicas = [
-            Replica(i, self.export_dir, self.policy, loaded, self.model,
-                    max_restarts=max_restarts, donate=donate)
-            for i in range(int(replicas))
-        ]
-        if warmup:
-            shape = tuple(loaded.meta.get("sample_shape")
-                          or self.model.data.sample_shape)
-            dtype = np.dtype(loaded.meta.get("sample_dtype") or
-                             np.float32)
-            for r in self.replicas:
-                # fn=session.infer: warmup compiles the same jitted fn
-                # but skips the serve_step fault site — a fault plan
-                # must take down served batches (supervised restart),
-                # not construction before the port is bound
-                r.batcher.warmup(shape, dtype, fn=r.session.infer)
+        #: meta of the version being served — the hot-reload
+        #: compatibility anchor (export_incompatibility)
+        self._meta = loaded.meta             # guarded_by: self._reload_lock
+        if self.decode:
+            # autoregressive mode (theanompi_tpu/decode): replicas are
+            # DecodeReplicas (paged KV-cache + continuous batcher) and
+            # the wire surface is the 'generate' op
+            if not loaded.meta.get("decode"):
+                raise ValueError(
+                    "decode mode needs a decode-capable export "
+                    "(TransformerLM family; export_meta 'decode' is "
+                    f"false/absent in {self.export_dir})")
+            from theanompi_tpu.decode import DecodePolicy, DecodeReplica
+
+            opts = dict(decode_opts or {})
+            pol_kw = {k: opts.pop(k)
+                      for k in ("max_pending", "max_new_cap",
+                                "submit_timeout_s", "eos_token")
+                      if k in opts}
+            self.replicas = [
+                DecodeReplica(i, self.export_dir, self.model, loaded,
+                              policy=DecodePolicy(**pol_kw),
+                              max_restarts=max_restarts, donate=donate,
+                              **opts)
+                for i in range(int(replicas))
+            ]
+            if warmup:
+                for r in self.replicas:
+                    r.session.warmup()
+        else:
+            self.replicas = [
+                Replica(i, self.export_dir, self.policy, loaded,
+                        self.model, max_restarts=max_restarts,
+                        donate=donate)
+                for i in range(int(replicas))
+            ]
+            if warmup:
+                shape = tuple(loaded.meta.get("sample_shape")
+                              or self.model.data.sample_shape)
+                dtype = np.dtype(loaded.meta.get("sample_dtype") or
+                                 np.float32)
+                for r in self.replicas:
+                    # fn=session.infer: warmup compiles the same jitted
+                    # fn but skips the serve_step fault site — a fault
+                    # plan must take down served batches (supervised
+                    # restart), not construction before the port is
+                    # bound
+                    r.batcher.warmup(shape, dtype, fn=r.session.infer)
         self._rr_lock = make_lock("InferenceServer._rr_lock")
         self._rr = 0                          # guarded_by: self._rr_lock
         self._stop = threading.Event()
         self._watcher: threading.Thread | None = None
         self._reload_lock = make_lock("InferenceServer._reload_lock")
-        #: newest published version that failed verification — skipped
-        #: by the reload poll until a strictly newer one appears
+        #: newest published version that failed verification or was
+        #: refused as incompatible — not re-LOADED by the reload poll
+        #: until a strictly newer one appears
         self._bad_newest: int | None = None  # guarded_by: self._reload_lock
+        #: refusal reason when _bad_newest was an IncompatibleExport:
+        #: re-raised (from memory, no disk load) on every further
+        #: reload of that version, so a client's reload() RPC gets the
+        #: typed error regardless of whether the background watcher
+        #: observed the publish first
+        self._bad_reason: str | None = None  # guarded_by: self._reload_lock
         monitor.set_gauge("serving/model_version", self.version)
         monitor.set_gauge("serving/replicas", len(self.replicas))
 
@@ -197,10 +248,9 @@ class InferenceServer:
 
     # -- request path --------------------------------------------------
 
-    def submit(self, x: np.ndarray) -> np.ndarray:
-        """Route one request to a live replica (round-robin with
-        full-queue failover); Overloaded only when EVERY live replica
-        rejects."""
+    def _route(self, fn_name: str, *args):
+        """Round-robin one request over live replicas with overflow
+        failover; Overloaded only when EVERY live replica rejects."""
         n = len(self.replicas)
         with self._rr_lock:
             start = self._rr
@@ -213,13 +263,31 @@ class InferenceServer:
                 continue
             any_alive = True
             try:
-                return r.batcher.submit(x)
+                return getattr(r, fn_name)(*args)
             except Overloaded as e:
                 last = e
         if not any_alive:
             raise Overloaded("no live replicas (all lost); the server "
                              "needs a restart or a good export")
         raise last if last is not None else Overloaded("rejected")
+
+    def submit(self, x: np.ndarray) -> np.ndarray:
+        """Route one eval request to a live replica."""
+        if self.decode:
+            raise ValueError("this server runs decode mode; use the "
+                             "'generate' op (InferenceClient.generate)")
+        return self._route("submit", x)
+
+    def generate(self, prompt: np.ndarray,
+                 max_new: int | None = None) -> np.ndarray:
+        """Route one token-generation request to a live decode
+        replica; returns the generated token ids (int32)."""
+        if not self.decode:
+            raise ValueError("this server runs eval mode; start it "
+                             "with decode=True (tmlocal SERVE "
+                             "--decode) for the generate op")
+        out = self._route("generate", prompt, max_new)
+        return np.asarray(out, np.int32)
 
     # -- hot reload ----------------------------------------------------
 
@@ -229,8 +297,15 @@ class InferenceServer:
         concurrently (watcher + the ``reload`` RPC)."""
         with self._reload_lock:
             newest = latest_export_version(self.export_dir)
-            if (newest is None or newest <= self.version
-                    or newest == self._bad_newest):
+            if newest is None or newest <= self.version:
+                return self.version
+            if newest == self._bad_newest:
+                if self._bad_reason is not None:
+                    # a REFUSED (not corrupt) publish: every reload of
+                    # it re-raises the typed error from memory, so the
+                    # refusal is observable however the poll race with
+                    # the watcher went
+                    raise IncompatibleExport(self._bad_reason)
                 return self.version
             loaded = load_export(self.export_dir)
             if loaded.version <= self.version:
@@ -242,11 +317,31 @@ class InferenceServer:
                 # churn — remember it and wait for a strictly newer
                 # manifest to reset the skip.
                 self._bad_newest = newest
+                self._bad_reason = None
                 return self.version
+            reason = export_incompatibility(self._meta, loaded.meta)
+            if reason is not None:
+                # refusal, not a crash: the export verified but must
+                # not be swapped into live replicas (different model /
+                # sample shape / net dims / weight dtype / decode
+                # capability).  Remember it like a corrupt newest so
+                # the poll loop does not re-LOAD it every interval —
+                # but keep the reason, so every reload of this version
+                # still surfaces the typed error; a strictly newer
+                # publish resets the skip.
+                self._bad_newest = newest
+                self._bad_reason = (f"refusing hot reload "
+                                    f"v{self.version} -> "
+                                    f"v{loaded.version}: {reason}")
+                monitor.inc("serving/reload_refused_total")
+                print(f"[serving] {self._bad_reason}", flush=True)
+                raise IncompatibleExport(self._bad_reason)
             self._bad_newest = None
+            self._bad_reason = None
             for r in self.replicas:
                 r.swap(loaded.version, loaded.params,
                        loaded.model_state)
+            self._meta = loaded.meta
             old, self.version = self.version, loaded.version
             monitor.set_gauge("serving/model_version", self.version)
             monitor.inc("serving/reloads_total")
@@ -259,6 +354,11 @@ class InferenceServer:
         while not self._stop.wait(self.reload_poll_s):
             try:
                 self.check_reload()
+            except IncompatibleExport:
+                # already printed once at refusal time; the remembered
+                # refusal re-raises every poll until superseded, and
+                # re-printing it each second is pure log spam
+                pass
             except Exception as e:
                 # a broken half-published export must not kill the
                 # watcher; next poll retries
@@ -279,16 +379,31 @@ class InferenceServer:
                          version=r.session.version)
                     for r in self.replicas]
             version = self.version
-        return {
+        out = {
             "version": version,
+            "decode": self.decode,
             "replicas": reps,
-            "batches": sum(r["batches"] for r in reps),
-            "rows": sum(r["rows"] for r in reps),
-            "overloaded": sum(r["overloaded"] for r in reps),
-            "max_occupancy": max((r["max_occupancy"] for r in reps),
-                                 default=0),
+            "overloaded": sum(r.get("overloaded", 0) for r in reps),
             "live_replicas": sum(1 for r in self.replicas if r.alive),
         }
+        if self.decode:
+            # decode replicas account tokens/steps, not batches/rows
+            out.update(
+                tokens=sum(r.get("tokens", 0) for r in reps),
+                steps=sum(r.get("steps", 0) for r in reps),
+                shared_steps=sum(r.get("shared_steps", 0)
+                                 for r in reps),
+                max_concurrent=max((r.get("max_concurrent", 0)
+                                    for r in reps), default=0),
+            )
+        else:
+            out.update(
+                batches=sum(r.get("batches", 0) for r in reps),
+                rows=sum(r.get("rows", 0) for r in reps),
+                max_occupancy=max((r.get("max_occupancy", 0)
+                                   for r in reps), default=0),
+            )
+        return out
 
     # -- wire dispatch ---------------------------------------------------
 
@@ -296,6 +411,11 @@ class InferenceServer:
         if op == "infer":
             (x,) = args
             return self.submit(np.asarray(x))
+        if op == "generate":
+            prompt, max_new = args
+            return self.generate(np.asarray(prompt, np.int32),
+                                 None if max_new is None
+                                 else int(max_new))
         if op == "stats":
             return self.stats()
         if op == "reload":
@@ -421,13 +541,36 @@ class InferenceClient(ServiceClient):
                 raise Overloaded(str(e)) from None
             raise
 
+    def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
+        """Greedy-decode up to ``max_new`` tokens after ``prompt`` on
+        a decode-mode server; returns the generated token ids (int32).
+        At-least-once safe like ``infer``: generation is deterministic
+        (greedy) given the export version, and a redelivered request
+        only costs duplicate work, never duplicate side effects."""
+        try:
+            return np.asarray(
+                self.call("generate",
+                          np.asarray(prompt, np.int32),
+                          None if max_new is None else int(max_new)),
+                np.int32)
+        except ServiceError as e:
+            if Overloaded.__name__ in str(e):
+                raise Overloaded(str(e)) from None
+            raise
+
     def stats(self) -> dict:
         return self.call("stats")
 
     def reload(self) -> int:
         """Force an immediate export-dir poll; returns the serving
-        version after it."""
-        return int(self.call("reload"))
+        version after it.  An incompatible published export re-raises
+        the server's typed :class:`IncompatibleExport` refusal."""
+        try:
+            return int(self.call("reload"))
+        except ServiceError as e:
+            if IncompatibleExport.__name__ in str(e):
+                raise IncompatibleExport(str(e)) from None
+            raise
 
     def shutdown(self) -> None:
         self.call("shutdown")
@@ -438,12 +581,32 @@ class InferenceClient(ServiceClient):
 # ---------------------------------------------------------------------------
 
 
+def decode_opts_from_args(args) -> dict | None:
+    """The ``--decode-*`` flags → ``InferenceServer(decode_opts=...)``
+    dict — ONE translation shared by the launcher's SERVE rule and
+    this module's CLI (identically-named flags in both parsers), so a
+    new decode knob cannot silently exist in one entry point only."""
+    if not args.decode:
+        return None
+    opts = {
+        "page_size": args.decode_page_size,
+        "pages_per_seq": args.decode_pages_per_seq,
+        "max_seqs": args.decode_max_seqs,
+        "max_pending": args.decode_max_pending,
+    }
+    if args.decode_prefill_buckets:
+        opts["prefill_buckets"] = tuple(
+            int(b) for b in args.decode_prefill_buckets.split(","))
+    return opts
+
+
 def serve_main(export_dir: str, host: str = "0.0.0.0",
                port: int = DEFAULT_PORT, replicas: int = 1,
                max_batch: int = 8, max_delay_ms: float = 5.0,
                buckets: tuple[int, ...] | None = None,
                max_queue: int = 32, max_restarts: int = 2,
-               reload_poll_s: float = 1.0) -> int:
+               reload_poll_s: float = 1.0, decode: bool = False,
+               decode_opts: dict | None = None) -> int:
     # persistent compilation cache before any replica warms up: the
     # per-bucket eval programs compile once per (shape, flags) EVER,
     # not once per server restart — a hot-standby restart re-serves in
@@ -461,13 +624,22 @@ def serve_main(export_dir: str, host: str = "0.0.0.0",
         monitor.progress(phase="serving")
         server = InferenceServer(
             export_dir, replicas=replicas, policy=policy,
-            max_restarts=max_restarts, reload_poll_s=reload_poll_s)
+            max_restarts=max_restarts, reload_poll_s=reload_poll_s,
+            decode=decode, decode_opts=decode_opts)
         server.start()
-        print(f"[serving] v{server.version} x{replicas} replicas on "
-              f"{host}:{port} (max_batch={max_batch}, "
-              f"max_delay={max_delay_ms}ms, "
-              f"buckets={server.policy.resolved_buckets()}, "
-              f"max_queue={max_queue})", flush=True)
+        if decode:
+            s0 = server.replicas[0].session
+            print(f"[serving] DECODE v{server.version} x{replicas} "
+                  f"replicas on {host}:{port} "
+                  f"(window={s0.window}, page_size={s0.cfg.page_size}, "
+                  f"max_seqs={s0.cfg.max_seqs}, "
+                  f"prefill_buckets={s0.prefill_buckets})", flush=True)
+        else:
+            print(f"[serving] v{server.version} x{replicas} replicas "
+                  f"on {host}:{port} (max_batch={max_batch}, "
+                  f"max_delay={max_delay_ms}ms, "
+                  f"buckets={server.policy.resolved_buckets()}, "
+                  f"max_queue={max_queue})", flush=True)
         try:
             serve(server, host, port)
         finally:
@@ -490,6 +662,18 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queue", type=int, default=32)
     ap.add_argument("--max-restarts", type=int, default=2)
     ap.add_argument("--reload-poll-s", type=float, default=1.0)
+    ap.add_argument("--decode", action="store_true",
+                    help="autoregressive mode (theanompi_tpu/decode): "
+                         "paged KV-cache + continuous batching; serves "
+                         "the 'generate' op for TransformerLM exports")
+    ap.add_argument("--decode-page-size", type=int, default=16)
+    ap.add_argument("--decode-pages-per-seq", type=int, default=8)
+    ap.add_argument("--decode-max-seqs", type=int, default=8)
+    ap.add_argument("--decode-max-pending", type=int, default=32)
+    ap.add_argument("--decode-prefill-buckets", default=None,
+                    metavar="N,N,...",
+                    help="padded prompt-length buckets (default powers "
+                         "of two up to min(512, max_len))")
     ap.add_argument("--platform", default=None,
                     help="jax platform (e.g. 'cpu')")
     ap.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
@@ -510,12 +694,14 @@ def main(argv=None) -> int:
             args.compilation_cache_dir
     buckets = (tuple(int(b) for b in args.buckets.split(","))
                if args.buckets else None)
+    decode_opts = decode_opts_from_args(args)
     return serve_main(args.export_dir, args.host, args.port,
                       replicas=args.replicas, max_batch=args.max_batch,
                       max_delay_ms=args.max_delay_ms, buckets=buckets,
                       max_queue=args.max_queue,
                       max_restarts=args.max_restarts,
-                      reload_poll_s=args.reload_poll_s)
+                      reload_poll_s=args.reload_poll_s,
+                      decode=args.decode, decode_opts=decode_opts)
 
 
 if __name__ == "__main__":

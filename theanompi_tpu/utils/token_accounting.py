@@ -1,0 +1,39 @@
+"""Token-throughput accounting shared by training and serving bench.
+
+``tools/bench_lm.py`` (training tokens/s) and ``tools/bench_serving.py
+--decode`` (served tokens/s) must compute the SAME quantity the same
+way, or a "serving reaches X% of training throughput" claim silently
+compares different arithmetic.  One helper, one definition:
+
+* a **token** is one position of one sequence that the model produced
+  or trained on — for training, ``steps * global_batch * seq_len``
+  (every position of every sequence gets a loss); for decode serving,
+  the number of GENERATED tokens (prompt positions are prefill work,
+  not output — they are counted separately by the prefill histogram);
+* **tokens/s** divides by the measurement wall window;
+* **tokens/s/chip** divides further by the participating chip count —
+  the BASELINE.md comparison axis (r3: 157k tok/s/chip).
+"""
+
+from __future__ import annotations
+
+
+def token_throughput(tokens: int, wall_s: float,
+                     n_chips: int = 1) -> dict:
+    """The canonical tokens/s record both bench tools embed.
+
+    Returns ``{tokens, wall_s, tokens_per_sec, tokens_per_sec_per_chip,
+    n_chips}`` — ``tokens_per_sec*`` are 0.0 for an empty window
+    rather than a ZeroDivisionError (a bench that measured nothing
+    should emit an honest zero, not crash after the run)."""
+    tokens = int(tokens)
+    wall_s = float(wall_s)
+    n_chips = max(1, int(n_chips))
+    rate = tokens / wall_s if wall_s > 0 else 0.0
+    return {
+        "tokens": tokens,
+        "wall_s": wall_s,
+        "n_chips": n_chips,
+        "tokens_per_sec": rate,
+        "tokens_per_sec_per_chip": rate / n_chips,
+    }

@@ -86,16 +86,22 @@ def main() -> int:
     assert np.isfinite(loss), loss
     model.cleanup()
 
-    tokens = args.steps * global_batch * args.seq
-    tok_s = tokens / dt
+    # one shared definition with bench_serving's decode mode
+    # (utils/token_accounting.py): training tokens are every position
+    # of every sequence, over the timed window, per chip
+    from theanompi_tpu.utils.token_accounting import token_throughput
+
+    rate = token_throughput(args.steps * global_batch * args.seq, dt,
+                            len(devices))
     tflops = (args.steps * global_batch * model.train_flops_per_sample
               / dt / 1e12)
     print(json.dumps({
         "metric": "transformer_lm_tokens_per_sec_per_chip",
-        "value": round(tok_s / len(devices), 1),
+        "value": round(rate["tokens_per_sec_per_chip"], 1),
         "unit": "tokens/sec/chip",
         "detail": {
             "n_chips": len(devices),
+            "tokens": rate["tokens"],
             "global_batch": global_batch,
             "seq_len": args.seq,
             "layers": args.layers, "d_model": args.d_model,

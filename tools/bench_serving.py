@@ -13,10 +13,18 @@ includes queueing and the admission controller's ``Overloaded``
 rejections are counted instead of letting the queue grow without
 bound.
 
+Decode (``--decode``): requests are token-generation streams against
+a ``tmlocal SERVE --decode`` server (theanompi_tpu/decode).  The
+headline numbers change axis: **tokens/s/chip** (the same accounting
+as tools/bench_lm.py — utils/token_accounting.py) and **inter-token
+latency p50/p99** from the server's own per-token histogram, measured
+under overload when the open-loop rate exceeds capacity.  The smoke
+artifact lives at ``artifacts/BENCH_decode_smoke.json``.
+
 Emits one ``BENCH_serving`` JSON (throughput, latency p50/p95/p99,
-batch occupancy from the server's own stats, overload counts) to
-``--out`` and prints it — same artifact discipline as the other bench
-tools.
+batch occupancy / decode sharing from the server's own stats, overload
+counts) to ``--out`` and prints it — same artifact discipline as the
+other bench tools.
 
 Usage:
     # against a running server (tmlocal SERVE ...):
@@ -24,6 +32,10 @@ Usage:
 
     # self-contained (exports a tiny model, serves in-process, drives it):
     JAX_PLATFORMS=cpu python tools/bench_serving.py --demo --mode closed
+
+    # token-throughput mode against a decode server (or --demo):
+    JAX_PLATFORMS=cpu python tools/bench_serving.py --demo --decode \
+        --mode open --rate 20 --gen-tokens 16
 """
 
 from __future__ import annotations
@@ -59,31 +71,50 @@ def _percentiles(ms: list[float]) -> dict:
             "p95": pick(0.95), "p99": pick(0.99), "max": float(a[-1])}
 
 
-def _demo_export(tmp_dir: str) -> str:
-    """Export an untrained TinyCifar so the tool runs anywhere."""
-    from tests._tiny_models import TinyCifar
+def _demo_export(tmp_dir: str, decode: bool = False) -> str:
+    """Export an untrained tiny model so the tool runs anywhere:
+    TinyCifar for eval mode, a small TransformerLM for --decode."""
     from theanompi_tpu.models.base import ModelConfig
     from theanompi_tpu.serving import export_model
 
-    model = TinyCifar(config=ModelConfig(batch_size=8, n_epochs=1,
-                                         print_freq=0), verbose=False)
+    if decode:
+        from theanompi_tpu.models.transformer import TransformerLM
+
+        cfg = ModelConfig(batch_size=4, n_epochs=1, print_freq=0,
+                          compute_dtype="float32", optimizer="adamw",
+                          learning_rate=1e-3, weight_decay=0.0,
+                          lr_schedule="constant")
+        model = TransformerLM(config=cfg, vocab=64, seq_len=32,
+                              n_layers=2, d_model=32, n_heads=2,
+                              verbose=False)
+    else:
+        from tests._tiny_models import TinyCifar
+
+        model = TinyCifar(config=ModelConfig(batch_size=8, n_epochs=1,
+                                             print_freq=0),
+                          verbose=False)
     export_dir = os.path.join(tmp_dir, "export")
     export_model(model, export_dir, version=0)
     return export_dir
 
 
 def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
-             rate: float, duration: float) -> dict:
+             rate: float, duration: float, decode: bool = False,
+             gen_tokens: int = 16) -> dict:
     from theanompi_tpu.serving import InferenceClient, Overloaded
 
     lock = threading.Lock()
     lat_ms: list[float] = []
-    counts = {"ok": 0, "overloaded": 0, "errors": 0}
+    counts = {"ok": 0, "overloaded": 0, "errors": 0, "tokens": 0}
 
     def one(client) -> None:
         t0 = time.monotonic()
         try:
-            client.infer(sample)
+            if decode:
+                out = client.generate(sample, gen_tokens)
+            else:
+                client.infer(sample)
+                out = None
         except Overloaded:
             with lock:
                 counts["overloaded"] += 1
@@ -95,6 +126,8 @@ def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
         dt = (time.monotonic() - t0) * 1e3
         with lock:
             counts["ok"] += 1
+            if out is not None:
+                counts["tokens"] += len(out)
             lat_ms.append(dt)
 
     t_start = time.monotonic()
@@ -113,7 +146,25 @@ def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
             t.join()
     else:  # open loop: Poisson arrivals, one short-lived thread each
         rng = np.random.default_rng(0)
-        pool = [InferenceClient(addr) for _ in range(clients)]
+        # eval requests are ~ms, so a small shared client pool
+        # approximates open-loop; a decode STREAM holds its connection
+        # for the whole generation (ServiceClient serializes per
+        # connection), so every in-flight stream needs its OWN
+        # connection or the pool lock — not the server — caps
+        # concurrency and the bench measures client queueing
+        pool = ([] if decode
+                else [InferenceClient(addr) for _ in range(clients)])
+
+        def one_arrival(i: int) -> None:
+            if decode:
+                c = InferenceClient(addr)
+                try:
+                    one(c)
+                finally:
+                    c.close()
+            else:
+                one(pool[i % clients])
+
         inflight: list[threading.Thread] = []
         i = 0
         next_t = t_start
@@ -122,7 +173,7 @@ def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
             delay = next_t - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            t = threading.Thread(target=one, args=(pool[i % clients],))
+            t = threading.Thread(target=one_arrival, args=(i,))
             t.start()
             inflight.append(t)
             i += 1
@@ -157,6 +208,20 @@ def main(argv=None) -> int:
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-delay-ms", type=float, default=5.0)
     ap.add_argument("--max-queue", type=int, default=32)
+    ap.add_argument("--decode", action="store_true",
+                    help="token-throughput mode: drive 'generate' "
+                         "streams against a decode server (tokens/s/"
+                         "chip + inter-token p50/p99 headline)")
+    ap.add_argument("--prompt-tokens", type=int, default=8,
+                    help="--decode: prompt length per stream")
+    ap.add_argument("--gen-tokens", type=int, default=16,
+                    help="--decode: tokens generated per stream")
+    ap.add_argument("--decode-max-seqs", type=int, default=8,
+                    help="--decode in-process server: max concurrent "
+                         "sequences per replica")
+    ap.add_argument("--decode-max-pending", type=int, default=32,
+                    help="--decode in-process server: admission bound "
+                         "(prompts beyond it get Overloaded)")
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
 
@@ -178,13 +243,19 @@ def main(argv=None) -> int:
             if export_dir is None:
                 if not args.demo:
                     ap.error("need --addr, --export-dir, or --demo")
-                export_dir = _demo_export(tmp_ctx.name)
+                export_dir = _demo_export(tmp_ctx.name,
+                                          decode=args.decode)
             policy = BatchPolicy(max_batch=args.max_batch,
                                  max_delay_ms=args.max_delay_ms,
                                  max_queue=args.max_queue)
+            decode_opts = (dict(max_seqs=args.decode_max_seqs,
+                                max_pending=args.decode_max_pending)
+                           if args.decode else None)
             server = InferenceServer(export_dir,
                                      replicas=args.replicas,
-                                     policy=policy).start()
+                                     policy=policy,
+                                     decode=args.decode,
+                                     decode_opts=decode_opts).start()
             port = _free_port()
             ready = threading.Event()
             thread = threading.Thread(
@@ -200,14 +271,24 @@ def main(argv=None) -> int:
                 meta = load_export(args.export_dir).meta
             else:
                 meta = {}
-        shape = tuple(meta.get("sample_shape") or (32, 32, 3))
-        dtype = np.dtype(meta.get("sample_dtype") or "uint8")
-        sample = np.zeros((args.rows, *shape), dtype)
+        if args.decode:
+            vocab = int((meta.get("net") or {}).get("vocab", 64))
+            sample = (np.arange(args.prompt_tokens, dtype=np.int32)
+                      % max(2, vocab - 1)) + 1
+        else:
+            shape = tuple(meta.get("sample_shape") or (32, 32, 3))
+            dtype = np.dtype(meta.get("sample_dtype") or "uint8")
+            sample = np.zeros((args.rows, *shape), dtype)
 
         probe = InferenceClient(addr)
-        probe.infer(sample)  # one warm request outside the window
+        if args.decode:  # one warm stream outside the window
+            probe.generate(sample, args.gen_tokens)
+        else:
+            probe.infer(sample)
         result = run_load(addr, sample, args.mode, args.clients,
-                          args.rate, args.duration)
+                          args.rate, args.duration,
+                          decode=args.decode,
+                          gen_tokens=args.gen_tokens)
         stats = probe.stats()
         if server is not None:
             probe.shutdown()
@@ -215,22 +296,57 @@ def main(argv=None) -> int:
         out = {
             "bench": "serving",
             "mode": args.mode,
+            "decode": args.decode,
             "clients": args.clients,
             "rate_rps": args.rate if args.mode == "open" else None,
-            "rows_per_request": args.rows,
             "server": {
                 "addr": addr,
                 "version": stats.get("version"),
                 "replicas": stats.get("live_replicas"),
-                "batches": stats.get("batches"),
-                "batch_rows": stats.get("rows"),
-                "max_occupancy": stats.get("max_occupancy"),
-                "mean_occupancy": (stats["rows"] / stats["batches"]
-                                   if stats.get("batches") else None),
                 "overloaded": stats.get("overloaded"),
             },
             **result,
         }
+        if args.decode:
+            # tokens/s accounted identically to training bench_lm.py
+            from theanompi_tpu.utils.token_accounting import (
+                token_throughput,
+            )
+
+            n_chips = 1
+            if server is not None:
+                import jax
+
+                n_chips = len(jax.devices())
+            reps = stats.get("replicas") or [{}]
+            out.update(
+                prompt_tokens=args.prompt_tokens,
+                gen_tokens_per_stream=args.gen_tokens,
+                throughput=token_throughput(result["tokens"],
+                                            result["wall_s"], n_chips),
+                intertoken_ms=reps[0].get("intertoken_ms"),
+                server_decode={
+                    "tokens": stats.get("tokens"),
+                    "steps": stats.get("steps"),
+                    "shared_steps": stats.get("shared_steps"),
+                    "max_concurrent": stats.get("max_concurrent"),
+                    "mean_tokens_per_step": (
+                        stats["tokens"] / stats["steps"]
+                        if stats.get("steps") else None),
+                },
+            )
+        else:
+            out.update(
+                rows_per_request=args.rows,
+                server_batching={
+                    "batches": stats.get("batches"),
+                    "batch_rows": stats.get("rows"),
+                    "max_occupancy": stats.get("max_occupancy"),
+                    "mean_occupancy": (stats["rows"] / stats["batches"]
+                                       if stats.get("batches")
+                                       else None),
+                },
+            )
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
         print(json.dumps(out, indent=1))

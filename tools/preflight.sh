@@ -194,6 +194,101 @@ PYEOF
   SERVING_RC=$?
   rm -rf "$SERVEDIR"
   echo "serving smoke rc=$SERVING_RC"
+  echo "## decode smoke (LM export -> decode server -> 2 concurrent streams, docs/SERVING.md 'Decode')"
+  # the autoregressive vertical end-to-end on CPU: export a tiny
+  # TransformerLM, serve it in decode mode on a real socket, drive two
+  # concurrent generate streams; at least one decode step must batch
+  # rows from BOTH sequences (iteration-level sharing), both streams
+  # must match the uncached full-forward argmax oracle, and the
+  # inter-token histogram must land in the monitor JSONL
+  DECODEDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$DECODEDIR" python - <<'PYEOF'
+import json, os, socket, threading
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from theanompi_tpu import monitor
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.models.transformer import TransformerLM
+from theanompi_tpu.serving import (InferenceClient, InferenceServer,
+                                   export_model, serve)
+
+mondir = os.environ["THEANOMPI_TPU_MONITOR"]
+cfg = ModelConfig(batch_size=4, n_epochs=1, print_freq=0,
+                  compute_dtype="float32", optimizer="adamw",
+                  learning_rate=1e-3, weight_decay=0.0,
+                  lr_schedule="constant")
+model = TransformerLM(config=cfg, vocab=32, seq_len=16, n_layers=2,
+                      d_model=16, n_heads=2, verbose=False)
+params = jax.device_get(model.state.params)
+export_dir = os.path.join(mondir, "export")
+export_model(model, export_dir, version=0)
+with monitor.session(run_dir=mondir, stall_after=float("inf")):
+    server = InferenceServer(
+        export_dir, replicas=1, reload_poll_s=0, model=model,
+        decode=True,
+        decode_opts=dict(page_size=4, pages_per_seq=8, max_seqs=4,
+                         prefill_buckets=(8,))).start()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ready = threading.Event()
+    t = threading.Thread(target=serve,
+                         args=(server, "127.0.0.1", port, ready),
+                         daemon=True)
+    t.start()
+    assert ready.wait(30)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 32, 5).astype(np.int32),
+               rng.integers(0, 32, 7).astype(np.int32)]
+    outs = [None, None]
+    clients = [InferenceClient(f"127.0.0.1:{port}") for _ in range(2)]
+    ths = [threading.Thread(
+        target=lambda i=i: outs.__setitem__(
+            i, clients[i].generate(prompts[i], 10))) for i in range(2)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(120)
+    # both streams token-identical to the uncached flax oracle
+    for p, o in zip(prompts, outs):
+        cur = [int(x) for x in p]
+        oracle = []
+        for _ in range(10):
+            lg = np.asarray(model.module.apply(
+                {"params": params}, jnp.asarray([cur], jnp.int32),
+                train=False, seq_axis=None))
+            tok = int(np.argmax(lg[0, -1])); oracle.append(tok)
+            cur.append(tok)
+        assert o is not None and list(o) == oracle, (o, oracle)
+    st = clients[0].stats()
+    assert st["decode"] is True
+    assert st["shared_steps"] >= 1, f"no shared decode step: {st}"
+    clients[0].shutdown()
+    for c in clients:
+        c.close()
+    t.join(timeout=5)
+    server.stop()
+recs = [json.loads(l)
+        for l in open(os.path.join(mondir, "metrics_rank0.jsonl"))]
+names = {r["name"] for r in recs}
+missing = {"decode/intertoken_ms", "decode/tokens_total",
+           "decode/steps_total"} - names
+assert not missing, f"snapshot missing decode series: {missing}"
+itl = next(r for r in recs if r["name"] == "decode/intertoken_ms")
+# 2 streams x 10 tokens, minus each stream's FIRST token (prefill's
+# output: queue+prefill latency, excluded from the inter-token SLO)
+assert itl["count"] == 18 and "p99" in itl, itl
+print(f"decode smoke OK: shared_steps={st['shared_steps']}, "
+      f"{st['tokens']} tokens / {st['steps']} steps, "
+      f"intertoken p99 {itl['p99']:.1f}ms in monitor JSONL")
+PYEOF
+  DECODE_RC=$?
+  rm -rf "$DECODEDIR"
+  echo "decode smoke rc=$DECODE_RC"
   echo "## exchange-bench smoke (wire v1 vs v2 over real sockets, docs/DESIGN.md 'Wire protocol v2')"
   # the comms vertical end-to-end: drive the ~25M-param ResNet-50-sized
   # tree through the param service in every protocol x compression x
@@ -240,7 +335,7 @@ PYEOF
   INGEST_RC=$?
   rm -rf "$INGESTDIR"
   echo "ingest smoke rc=$INGEST_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ]; then
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
