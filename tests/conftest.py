@@ -63,7 +63,7 @@ def pytest_collection_modifyitems(config, items):
 #: keeps running)
 _REPO_THREAD_NAMES = ("-exchange-", "serving-batcher-",
                       "serving-reload-watcher", "monitor-heartbeat-",
-                      "ingest-", "decode-")
+                      "ingest-", "decode-", "rpc-")
 #: library pools that are non-daemon BY DESIGN and process-lived
 #: (concurrent.futures executors inside jax/orbax) — not leaks
 _POOL_THREAD_PREFIXES = ("ThreadPoolExecutor", "asyncio_", "grpc",
@@ -105,6 +105,16 @@ def thread_leak_guard():
                     "close/stop the owning object (pipe.close(), "
                     "batcher.stop(), server.stop(), monitor session "
                     "exit) before returning")
+
+
+@pytest.fixture(params=["threaded", "selector"])
+def rpc_loop(request, monkeypatch):
+    """Both RPC substrates (parallel/rpc.py, ISSUE 11): tests naming
+    this fixture run once per loop, so every byte-identity / fence /
+    failover pin that opts in covers the legacy thread-per-connection
+    loop AND the selector event plane during the migration window."""
+    monkeypatch.setenv("THEANOMPI_TPU_RPC_LOOP", request.param)
+    return request.param
 
 
 @pytest.fixture(scope="session")

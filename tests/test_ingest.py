@@ -228,7 +228,7 @@ class TestEpochOrder:
 
 class TestRemoteStream:
     @pytest.mark.parametrize("n_readers", [1, 2, 3])
-    def test_byte_identical_across_fleet_sizes(self, shard_tree,
+    def test_byte_identical_across_fleet_sizes(self, rpc_loop, shard_tree,
                                                dataset, n_readers):
         """The acceptance pin: every fleet size N yields EXACTLY the
         in-process loader's stream — same seed, one permutation per
@@ -244,6 +244,21 @@ class TestRemoteStream:
                 assert all(s > 0 for s in served), served
         finally:
             fleet.stop()
+
+    def test_mux_pipes_byte_identical(self, fleet2, dataset,
+                                      shard_tree, monkeypatch):
+        """ISSUE 11: with mux on, the control clients and the pull
+        pipeline to each reader share one multiplexed socket — and
+        the stream stays byte-identical to the in-process loader."""
+        monkeypatch.setenv("THEANOMPI_TPU_RPC_LOOP", "selector")
+        with RemoteBatchSource(fleet2.ingest_addrs, data=dataset,
+                               epoch=1, global_batch=BATCH,
+                               mux=True) as src:
+            remote = list(src)
+            # one shared transport per reader peer, all mux-granted
+            assert src._transports and all(
+                t.mux for t in src._transports.values())
+        _assert_streams_equal(remote, _local_stream(dataset, 1))
 
     def test_sharded_trainer_streams(self, fleet2, dataset, shard_tree):
         """Async-rule trainers (rank r of s) each see their own

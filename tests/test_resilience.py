@@ -525,7 +525,7 @@ class TestServiceResilience:
             _shutdown_service(port)
             t.join(timeout=5)
 
-    def test_client_survives_server_restart(self, service_env):
+    def test_client_survives_server_restart(self, service_env, rpc_loop):
         """Acceptance-criteria case: a ServiceClient reconnects
         through a full parameter-service restart (new process-worth of
         state: the store is GONE) without losing session state — the
@@ -597,7 +597,7 @@ class TestServiceResilience:
             _shutdown_service(port)
             t2.join(timeout=5)
 
-    def test_lost_reply_retries_idempotent_tolerant_op(self, service_env):
+    def test_lost_reply_retries_idempotent_tolerant_op(self, service_env, rpc_loop):
         """easgd_exchange tolerates at-least-once: a reply lost after
         the server applied it is re-sent (one extra elastic pull)."""
         from theanompi_tpu.parallel.service import RemoteEASGD
@@ -634,7 +634,7 @@ class TestServiceResilience:
             _shutdown_service(port)
             t.join(timeout=5)
 
-    def test_lost_reply_does_not_resend_gossip_ops(self, service_env):
+    def test_lost_reply_does_not_resend_gossip_ops(self, service_env, rpc_loop):
         """AT-MOST-ONCE for gossip push/drain (code-review finding):
         once the request is on the wire, a lost reply must RAISE, not
         re-send — a re-applied push double-delivers gossip weight and

@@ -40,12 +40,14 @@ def _free_port() -> int:
 
 
 @pytest.fixture(params=["v1", "v2"])
-def local_service(request, monkeypatch):
+def local_service(request, monkeypatch, rpc_loop):
     """serve() on a background thread (same process, real sockets).
 
-    Parametrized over both wire protocols (ISSUE 5): every store test
-    below runs once over v1 pickle and once over v2 framed transport —
-    same arithmetic, same restored trees, both directions."""
+    Parametrized over both wire protocols (ISSUE 5) AND both RPC
+    substrates (ISSUE 11, ``rpc_loop`` in conftest): every store test
+    below runs over v1 pickle and v2 framed transport on the threaded
+    loop and the selector event plane — same arithmetic, same restored
+    trees, both directions, both loops."""
     monkeypatch.setenv("THEANOMPI_TPU_WIRE_PROTOCOL", request.param)
     key_before = os.environ.get("THEANOMPI_TPU_SERVICE_KEY")
     port = _free_port()

@@ -582,7 +582,7 @@ class TestServerIntegration:
             else:
                 os.environ["THEANOMPI_TPU_SERVICE_KEY"] = key_before
 
-    def test_replica_restarts_from_export_on_fault(self, tiny_export):
+    def test_replica_restarts_from_export_on_fault(self, tiny_export, rpc_loop):
         """resilience wiring: an injected ``serve_step`` crash fails
         that batch (surfaced to its client), the replica reloads the
         verified export, and serving continues."""

@@ -146,7 +146,7 @@ class TestPartition:
 
 class TestEquivalence:
     @pytest.mark.parametrize("k", [2, 4])
-    def test_easgd_byte_identical_every_exchange(self, shard_env, k):
+    def test_easgd_byte_identical_every_exchange(self, shard_env, rpc_loop, k):
         """Acceptance pin: a fixed-seed exchange sequence against K=2
         and K=4 shards reassembles byte-identically to the K=1
         single-center run at EVERY exchange, and the fenced center
@@ -271,7 +271,7 @@ def test_restored_tree_byte_exact_per_shard(shard_env, monkeypatch,
 
 
 class TestVersionFence:
-    def test_atomic_cut_under_concurrent_exchanges(self, shard_env):
+    def test_atomic_cut_under_concurrent_exchanges(self, shard_env, rpc_loop):
         """THE atomicity pin: fenced reads taken while a worker
         exchanges concurrently always equal the oracle center at
         exactly the version the fence's vector clock names — never a
@@ -327,7 +327,51 @@ class TestVersionFence:
         finally:
             _stop_fleet(fleet)
 
-    def test_concurrent_readers_fence_busy_retries(self, shard_env):
+    def test_fence_over_mux_shared_sockets(self, shard_env,
+                                           monkeypatch):
+        """ISSUE 11: with THEANOMPI_TPU_SHARD_MUX=1 each shard's data
+        client and fence client share ONE multiplexed socket.  The
+        fence must still cut consistently under a concurrent exchange
+        — safe because the selector loop routes shard_freeze/release
+        to its control pool, so a freeze-parked mutation parks a
+        worker, never the shared connection's read loop."""
+        monkeypatch.setenv("THEANOMPI_TPU_RPC_LOOP", "selector")
+        monkeypatch.setenv("THEANOMPI_TPU_SHARD_MUX", "1")
+        tree = _tree(11)
+        fleet = _start_fleet(2)
+        try:
+            srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                               alpha=0.5, session_id="mux-fence")
+            # the transports really multiplex (server granted mux)
+            assert srv._transports and all(t.mux
+                                           for t in srv._transports)
+            oracle = EASGDServer(tree, alpha=0.5)
+            w = jax.tree.map(lambda x: x + np.float32(0.25), tree)
+            _assert_bytes_equal(
+                srv.exchange(w),
+                jax.tree.map(np.asarray,
+                             jax.device_get(oracle.exchange(w))),
+                "exchange over mux")
+            done = threading.Event()
+
+            def mutate():
+                while not done.is_set():
+                    srv.exchange(w)
+
+            mt = threading.Thread(target=mutate)
+            mt.start()
+            try:
+                for _ in range(5):
+                    cut, vclock = srv.fenced_center()
+                    assert vclock  # a consistent cut came back
+            finally:
+                done.set()
+                mt.join(timeout=30)
+            srv.close()
+        finally:
+            _stop_fleet(fleet)
+
+    def test_concurrent_readers_fence_busy_retries(self, shard_env, rpc_loop):
         """Two readers fencing the same fleet (orchestrator +
         supervisor restart, say) both succeed — FenceBusy is retried,
         not surfaced."""
@@ -386,7 +430,7 @@ class TestVersionFence:
         finally:
             _stop_fleet(fleet)
 
-    def test_stable_divergence_accepted(self, shard_env):
+    def test_stable_divergence_accepted(self, shard_env, rpc_loop):
         """Liveness under dead history (code-review finding): a client
         that died mid-scatter leaves its tag on SOME shards forever —
         exact clock equality is then permanently unreachable, but the

@@ -344,20 +344,37 @@ class TestDecoderHardening:
 
 class TestNegotiation:
     def test_accept_hello_happy_path(self):
-        opts, reply = wire.accept_hello(
+        opts, reply, mux = wire.accept_hello(
             {"version": 2, "compression": "zlib", "dtype": "bf16"})
         assert opts.compression == "zlib" and opts.dtype == "bf16"
         assert reply == {"version": 2, "compression": "zlib",
                          "dtype": "bf16"}
+        assert mux is False
         # the server decodes peer frames with the pickle escape OFF:
         # an authenticated-but-hostile client must not reach
         # pickle.loads (the security note in docs/DESIGN.md)
         assert opts.allow_pickle is False
 
     def test_accept_hello_degrades_unknown_options(self):
-        opts, _ = wire.accept_hello(
+        opts, _, _ = wire.accept_hello(
             {"version": 2, "compression": "zstd", "dtype": "fp8"})
         assert opts.compression == "none" and opts.dtype == "f32"
+
+    def test_accept_hello_mux_needs_server_grant(self):
+        """The mux request key (parallel/rpc.py) is granted only when
+        the serving loop can demultiplex, and never granted unasked —
+        a legacy client's hello (no key) stays non-mux on every
+        server, so the framing after the hello is byte-identical to
+        the pre-rpc wire."""
+        hello = {"version": 2, "compression": "none", "dtype": "f32",
+                 "mux": True}
+        opts, reply, mux = wire.accept_hello(hello, allow_mux=True)
+        assert mux is True and reply["mux"] is True
+        opts, reply, mux = wire.accept_hello(hello, allow_mux=False)
+        assert mux is False and "mux" not in reply
+        opts, reply, mux = wire.accept_hello(
+            {"version": 2}, allow_mux=True)
+        assert mux is False and "mux" not in reply
 
     def test_accept_hello_rejects_other_versions(self):
         with pytest.raises(wire.WireProtocolError):

@@ -32,6 +32,12 @@ Mechanics:
   streams at full socket rate.  The in-flight window doubles as the
   trainer-side backpressure: a slow consumer freezes the window,
   which idles the fleet — no queue anywhere grows past ``depth``.
+* **one socket per reader peer (optional)** — ``mux=True`` (env
+  ``THEANOMPI_TPU_INGEST_MUX=1``) rides the RPC substrate's stream
+  multiplexing (``parallel/rpc.py``): the meta/probe control clients
+  and the pull pipeline to one reader share one authenticated socket;
+  against a non-mux server every stream silently falls back to its
+  own socket.
 * **overload** — a reader's typed ``Overloaded`` rejection reschedules
   the pull after a short jittered backoff (kept small: a backed-off
   index can be the stream's head-of-line, and everything behind the
@@ -51,7 +57,6 @@ import threading
 import time
 from collections import deque
 from multiprocessing.connection import Client as _MpClient
-from multiprocessing.connection import wait as _conn_wait
 
 import numpy as np
 
@@ -60,6 +65,7 @@ from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
 from theanompi_tpu.ingest import protocol
 from theanompi_tpu.ingest.protocol import ingest_addresses  # re-export
 from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel.rpc import wait_readable as _wait_readable
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
 
@@ -95,21 +101,32 @@ def _control_retry() -> RetryPolicy:
 
 
 class _ReaderPipe:
-    """One pipelined connection to one reader, owned by the fetch
-    thread (single-threaded by design — no locking): HMAC connect +
-    the same silent wire-v2 negotiation ``ServiceClient`` does, plus a
-    FIFO of in-flight (index, t_sent) — the serve loop answers one
-    connection's requests in order, so reply k is the FIFO's head."""
+    """One pipelined stream to one reader, owned by the fetch thread
+    (single-threaded by design — no locking): HMAC connect + the same
+    silent wire-v2 negotiation ``ServiceClient`` does, plus a FIFO of
+    in-flight (index, t_sent) — the serve loop answers one stream's
+    requests in order, so reply k is the FIFO's head.
 
-    def __init__(self, addr: str):
+    ``transport`` (a ``rpc.MuxConnection``) makes the pipe one logical
+    stream on a shared socket instead of its own connection — the
+    control-plane clients and the pull pipeline to one reader then
+    cost one fd between them (``THEANOMPI_TPU_INGEST_MUX``)."""
+
+    def __init__(self, addr: str, transport=None):
         from theanompi_tpu.parallel.service import _authkey
 
         host, _, port = addr.rpartition(":")
         self.addr = addr
-        self.conn = _MpClient((host or "127.0.0.1", int(port)),
-                              authkey=_authkey())
-        self.fifo: deque = deque()  # (index, t_sent)
         self.wire: wire.WireOptions | None = None
+        self.fifo: deque = deque()  # (index, t_sent)
+        if transport is not None:
+            self.conn, pre = transport.connect_stream()
+            if pre is not None:
+                self.wire = pre
+                return  # negotiation inherited from the transport
+        else:
+            self.conn = _MpClient((host or "127.0.0.1", int(port)),
+                                  authkey=_authkey())
         if os.environ.get("THEANOMPI_TPU_WIRE_PROTOCOL", "v2") == "v2":
             want = wire.WireOptions.from_env()
             self.conn.send((wire.HELLO_OP, wire.hello_payload(want)))
@@ -149,7 +166,7 @@ class RemoteBatchSource:
 
     def __init__(self, addresses: list[str], data, epoch: int,
                  global_batch: int, rank: int = 0, size: int = 1,
-                 depth: int | None = None):
+                 depth: int | None = None, mux: bool | None = None):
         if getattr(data, "device_transform", None) is None:
             raise ValueError(
                 "distributed ingest ships raw uint8 store batches; the "
@@ -166,6 +183,14 @@ class RemoteBatchSource:
         self.depth = depth if depth is not None else _default_depth()
         if self.depth < 1:
             raise ValueError(f"depth must be >= 1, got {self.depth}")
+        #: one multiplexed socket per reader peer (parallel/rpc.py):
+        #: the meta/probe control clients and the pull pipeline share
+        #: it, and against a non-mux server every stream silently gets
+        #: its own socket — so this is safe to leave on either way
+        self._mux = (mux if mux is not None else os.environ.get(
+            "THEANOMPI_TPU_INGEST_MUX", "0") == "1")
+        #: addr -> rpc.MuxConnection; fetch thread + constructor only
+        self._transports: dict = {}
 
         # consumer-facing state (fetch thread produces, __next__
         # consumes)
@@ -190,10 +215,27 @@ class RemoteBatchSource:
 
     # -- fleet resolution (control plane: plain ServiceClient) ---------
 
+    def _transport(self, addr: str):
+        """The shared per-peer mux transport (None when mux is off)."""
+        if not self._mux:
+            return None
+        t = self._transports.get(addr)
+        if t is None:
+            from theanompi_tpu.parallel.rpc import MuxConnection
+
+            t = self._transports[addr] = MuxConnection(addr)
+        return t
+
+    def _drop_transport(self, addr: str) -> None:
+        t = self._transports.pop(addr, None)
+        if t is not None:
+            t.close()
+
     def _control_client(self, addr: str):
         from theanompi_tpu.parallel.service import ServiceClient
 
-        return ServiceClient(addr, retry=_control_retry())
+        return ServiceClient(addr, retry=_control_retry(),
+                             transport=self._transport(addr))
 
     def _resolve_fleet(self, addresses: list[str], sig: dict) -> None:
         probe = self._control_client(addresses[0])
@@ -330,7 +372,9 @@ class RemoteBatchSource:
                     if not sent_any:
                         time.sleep(0.005)
                     continue
-                for conn in _conn_wait(busy, timeout=0.05):
+                # rpc.wait_readable == multiprocessing.connection.wait
+                # for plain sockets, and also understands mux streams
+                for conn in _wait_readable(busy, timeout=0.05):
                     pipe = by_conn[conn]
                     self._collect(pipe, pipes, by_conn, retries,
                                   resends, backoffs)
@@ -352,7 +396,8 @@ class RemoteBatchSource:
         try:
             pipe = pipes.get(addr)
             if pipe is None:
-                pipe = pipes[addr] = _ReaderPipe(addr)
+                pipe = pipes[addr] = _ReaderPipe(
+                    addr, transport=self._transport(addr))
                 by_conn[pipe.conn] = pipe
             pipe.send((protocol.OP_BATCH, self.epoch, self.rank,
                        self.size, self.global_batch, idx))
@@ -414,6 +459,8 @@ class RemoteBatchSource:
             by_conn.pop(pipe.conn, None)
             lost += [idx for idx, _ in pipe.fifo]
             pipe.close()
+        # a fresh retry must not inherit the dead peer's mux socket
+        self._drop_transport(addr)
         self._fail_over(addr)
         for idx in lost:
             self._requeue(idx, pending, resends, delay=0.0)
@@ -457,6 +504,9 @@ class RemoteBatchSource:
         self._thread.join(timeout=10)
         if self._coord is not None:
             self._coord.close()
+        for t in list(self._transports.values()):
+            t.close()
+        self._transports.clear()
 
     def __enter__(self):
         return self

@@ -116,6 +116,11 @@ class IngestReader:
                                          self.seed)
         self._max_inflight = (max_inflight if max_inflight is not None
                               else _default_max_inflight())
+        #: RPC-substrate executor width (parallel/rpc.py): a handler
+        #: blocks on its assembly future, so the pool must admit
+        #: max_inflight concurrent pulls plus slack — the O(1)
+        #: Overloaded rejection needs a worker free to run it
+        self.RPC_MAX_WORKERS = self._max_inflight + 4
         #: O(1) admission bound = the bounded queue (class docstring);
         #: a Semaphore is internally synchronized
         self._admission = threading.Semaphore(self._max_inflight)
@@ -265,6 +270,12 @@ class IngestReader:
                                  for k, v in self._assigned.items()},
                     "max_inflight": self._max_inflight,
                     "n_files": len(self.files)}
+
+    #: control-plane ops (parallel/rpc.py): meta checks, assignment
+    #: pushes, and stats must not queue behind a pool of batch pulls
+    #: parked on assembly futures
+    RPC_CONTROL_OPS = frozenset({protocol.OP_INFO, protocol.OP_META,
+                                 protocol.OP_ASSIGN, "stats"})
 
     def handle(self, op: str, *args):
         if op == protocol.OP_BATCH:

@@ -19,9 +19,12 @@ them (``server_addr`` becomes a comma-separated fleet; see
 :class:`ShardedServiceClient` and docs/DESIGN.md "Sharded parameter
 service").
 
-Transport: ``multiprocessing.connection`` (stdlib) with HMAC
-challenge/response auth, speaking one of two protocols negotiated per
-connection at handshake time (docs/DESIGN.md "Wire protocol v2"):
+Transport: the shared RPC substrate (``parallel/rpc.py``, docs/
+DESIGN.md "RPC substrate") — a selector event loop by default
+(``THEANOMPI_TPU_RPC_LOOP``), ``multiprocessing.connection``-framed
+chunks with HMAC challenge/response auth under a handshake deadline,
+speaking one of two protocols negotiated per connection at handshake
+time (docs/DESIGN.md "Wire protocol v2"):
 
 * **v2 framed** (default) — ``parallel/wire.py``: a fixed binary
   header + JSON skeleton per message with every ndarray sent as its
@@ -62,7 +65,7 @@ import os
 import threading
 import time
 import uuid
-from multiprocessing.connection import Client, Connection, Listener
+from multiprocessing.connection import Client
 from typing import Any
 
 import jax
@@ -70,7 +73,7 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_lock
-from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel import rpc, wire
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
 
@@ -299,16 +302,51 @@ class ParamService:
         "gosgd_push", "gosgd_drain", "gosgd_deactivate",
     })
 
+    #: latency-critical ops the RPC substrate routes to its control
+    #: pool (parallel/rpc.py): a session rejoin during a restart storm
+    #: must not queue behind a pool full of parked exchanges
+    RPC_CONTROL_OPS = frozenset({"join", "rejoin", "stats"})
+
+
+class _ServiceRpcHooks(rpc.RpcHooks):
+    """The param-service plane's seams into the shared RPC substrate
+    (``parallel/rpc.py``): literal ``service/*`` series names so the
+    TM403/404 docs-coverage lint keeps seeing every emission, and the
+    request-driven progress heartbeat."""
+
+    plane = "service"
+
+    def on_connect(self) -> None:
+        monitor.add_gauge("service/clients", 1.0)
+
+    def on_disconnect(self) -> None:
+        monitor.add_gauge("service/clients", -1.0)
+
+    def on_request(self, op: str, ms: float) -> None:
+        monitor.inc("service/requests_total", op=op)
+        monitor.observe("service/rpc_ms", ms, op=op)
+        # served work IS this process's progress
+        monitor.progress(phase="serving")
+
+    def on_error(self, op: str) -> None:
+        monitor.inc("service/errors_total", op=op)
+
+    def on_negotiate(self, opts: wire.WireOptions) -> None:
+        monitor.inc("service/wire_negotiations_total",
+                    compression=opts.compression, dtype=opts.dtype)
+
 
 def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
           ready_event: threading.Event | None = None,
           stop_event: threading.Event | None = None,
           authkey: bytes | None = None,
-          service: ParamService | None = None) -> None:
-    """Run the service until a ``shutdown`` op (or ``stop_event``).
-    One handler thread per connection; each worker thread keeps its own
-    persistent connection, so worker exchanges proceed concurrently up
-    to the store's own lock.
+          service: ParamService | None = None,
+          loop: str | None = None,
+          max_workers: int | None = None) -> None:
+    """Run the service until a ``shutdown`` op (or ``stop_event``) —
+    the param-service plane of the shared RPC substrate
+    (``parallel/rpc.py``; ``loop=None`` reads
+    ``THEANOMPI_TPU_RPC_LOOP``, default the selector event loop).
 
     ``authkey=None`` reads ``THEANOMPI_TPU_SERVICE_KEY`` — generating,
     printing, and exporting a random key into this process's environment
@@ -318,221 +356,27 @@ def serve(host: str = "0.0.0.0", port: int = DEFAULT_PORT,
     talks to OTHER services under different keys.
 
     ``service`` overrides the dispatcher — ``parallel/shards.py`` runs
-    this same loop over a :class:`ShardParamService` (version-fenced
-    shard of a partitioned center)."""
+    this same loop over a ``ShardParamService`` (version-fenced shard
+    of a partitioned center), ``ingest/reader.py`` over an
+    ``IngestReader``, ``ingest/coordinator.py`` over a coordinator.
+    ``max_workers`` caps the selector loop's executor pool; a service
+    that knows its admission bound exposes it as ``RPC_MAX_WORKERS``
+    (in-flight work, never connection count, bounds thread count)."""
     if service is None:
         service = ParamService()
-    if stop_event is None:
-        stop_event = threading.Event()  # so the shutdown op works
     if authkey is None:
         authkey = _authkey(generate=True)
-    # backlog: the stdlib default is 1, and on Linux a connect that
+    if max_workers is None:
+        max_workers = getattr(service, "RPC_MAX_WORKERS", None)
+    # backlog=64: the stdlib default is 1, and on Linux a connect that
     # overflows the accept queue looks ESTABLISHED to the client while
-    # the server never saw it — the client then blocks forever waiting
-    # for an HMAC challenge that will never come.  A burst of
-    # legitimate connects (an ingest trainer fleet opening control +
-    # pipelined-pull connections, K shard clients, a worker pool
-    # reconnecting after a restart) must queue, not wedge.
-    listener = Listener((host, port), backlog=64, authkey=authkey)
-    if ready_event is not None:
-        ready_event.set()
-    # live established connections, closed when the serve loop exits:
-    # an embedded (thread-hosted) service restart must look like a
-    # process restart to its clients — handler threads parked in recv
-    # on a dead service's store would otherwise keep answering
-    conns: set[Connection] = set()
-    conns_lock = threading.Lock()
-
-    def handle_conn(conn: Connection):
-        # connected-client gauge: one handler thread per connection, so
-        # inc/dec here IS the live connection count
-        monitor.add_gauge("service/clients", 1.0)
-        # per-connection protocol state: None = v1 pickle (every
-        # connection starts there; the HMAC handshake already ran
-        # inside Listener.accept); a successful wire_hello switches
-        # BOTH directions to v2 framing for the rest of the connection
-        wire_opts: wire.WireOptions | None = None
-
-        def reply(payload, op: str = "reply"):
-            """Send a reply in the connection's current protocol.
-            True = payload sent as-is; the (truthy) string 'degraded'
-            = a serialize/encode failure was converted to an err
-            diagnostic, charged to ``op``; False = peer gone (caller
-            returns)."""
-            try:
-                if wire_opts is None:
-                    conn.send(payload)
-                else:
-                    wire.send_msg(conn, payload, wire_opts)
-                return True
-            except (EOFError, OSError):
-                return False
-            except Exception as e:
-                # reply failed to SERIALIZE/ENCODE (both transports
-                # build the full message before any byte hits the
-                # wire) — the client must still get a diagnostic, not
-                # a bare EOFError
-                monitor.inc("service/errors_total", op=op)
-                try:
-                    err = ("err", f"{type(e).__name__}: {e}")
-                    if wire_opts is None:
-                        conn.send(err)
-                    else:
-                        wire.send_msg(conn, err, wire_opts)
-                    return "degraded"
-                except Exception:
-                    return False
-
-        try:
-            while True:
-                if wire_opts is None:
-                    try:
-                        msg = conn.recv()
-                    except (EOFError, OSError):
-                        return
-                    except Exception as e:
-                        if isinstance(e, TypeError) and conn.closed:
-                            # the shutdown path closed this connection
-                            # out from under a blocked recv — the
-                            # stdlib reads from a None handle.  An
-                            # OPEN conn's TypeError is a corrupt
-                            # pickle (e.g. a hostile __reduce__) and
-                            # falls through to the diagnostic below
-                            return
-                        # corrupt/unpicklable v1 request: surface a
-                        # typed diagnostic instead of silently
-                        # killing the handler thread
-                        monitor.inc("service/errors_total",
-                                    op="malformed")
-                        if not reply(("err",
-                                      f"{type(e).__name__}: {e}")):
-                            return
-                        continue
-                else:
-                    try:
-                        msg = wire.recv_msg(conn, wire_opts)
-                    except wire.WireDecodeError as e:
-                        # typed decode failure, never a hang: the
-                        # peer gets a diagnostic; the connection
-                        # survives when the frame was drained
-                        # (stream still aligned), closes otherwise
-                        monitor.inc("service/errors_total",
-                                    op="wire_decode")
-                        ok = reply(("err",
-                                    f"{type(e).__name__}: {e}"))
-                        if not ok or not getattr(
-                                e, "frame_drained", False):
-                            return
-                        continue
-                    except (EOFError, OSError):
-                        return
-                    except TypeError:
-                        if conn.closed:
-                            # shutdown closed the connection under a
-                            # blocked recv (None handle read)
-                            return
-                        raise  # a genuine bug — don't mask it
-                if not isinstance(msg, tuple) or not msg:
-                    monitor.inc("service/errors_total", op="malformed")
-                    if not reply(("err", "malformed request")):
-                        return
-                    continue
-                op, *args = msg
-                if op == wire.HELLO_OP:
-                    # version negotiation: confirm v2 + options on
-                    # the CURRENT protocol, then switch framing (a
-                    # legacy server would answer "unknown op" and
-                    # the client stays on v1)
-                    try:
-                        negotiated, hello_reply = wire.accept_hello(
-                            args[0] if args else None)
-                    except wire.WireProtocolError as e:
-                        if not reply(("err",
-                                      f"{type(e).__name__}: {e}")):
-                            return
-                        continue
-                    if not reply(("ok", hello_reply)):
-                        return
-                    wire_opts = negotiated
-                    monitor.inc("service/wire_negotiations_total",
-                                compression=negotiated.compression,
-                                dtype=negotiated.dtype)
-                    continue
-                if op == "shutdown":
-                    reply(("ok", None))
-                    if stop_event is not None:
-                        stop_event.set()
-                    # unblock accept() so the serve loop exits
-                    try:
-                        Client((host if host != "0.0.0.0"
-                                else "127.0.0.1",
-                                port), authkey=authkey).close()
-                    except OSError:
-                        pass
-                    return
-                t0 = time.monotonic()
-                try:
-                    result = service.handle(op, *args)
-                except Exception as e:  # surfaced client-side
-                    monitor.inc("service/errors_total", op=op)
-                    if not reply(("err", f"{type(e).__name__}: {e}")):
-                        return
-                    continue
-                sent = reply(("ok", result), op=op)
-                if not sent:
-                    return  # peer gone; nothing to tell it
-                if sent is True:
-                    # a degraded (serialize-failed) reply was
-                    # already charged to errors_total under this
-                    # op — it must not also count as a success
-                    monitor.inc("service/requests_total", op=op)
-                    monitor.observe("service/rpc_ms",
-                                    (time.monotonic() - t0) * 1e3,
-                                    op=op)
-                # served work IS this process's progress
-                monitor.progress(phase="serving")
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            with conns_lock:
-                conns.discard(conn)
-            monitor.add_gauge("service/clients", -1.0)
-
-    from multiprocessing import AuthenticationError
-
-    try:
-        with listener:
-            while stop_event is None or not stop_event.is_set():
-                try:
-                    conn = listener.accept()
-                except AuthenticationError:
-                    continue  # a bad-key peer must not kill the service
-                except OSError:
-                    if stop_event is not None and stop_event.is_set():
-                        return
-                    raise
-                # register BEFORE the handler thread starts: a conn
-                # accepted just as shutdown lands must still be in the
-                # close sweep, or its handler would keep serving the
-                # retired service object
-                with conns_lock:
-                    conns.add(conn)
-                threading.Thread(target=handle_conn, args=(conn,),
-                                 daemon=True).start()
-    finally:
-        # faithful shutdown: drop established connections so an
-        # embedded service restart looks like a process restart (the
-        # blocked recv in each handler raises and the thread exits;
-        # clients enter their reconnect/rejoin path)
-        with conns_lock:
-            live = list(conns)
-        for c in live:
-            try:
-                c.close()
-            except OSError:
-                pass
+    # the server never saw it — a burst of legitimate connects (an
+    # ingest trainer fleet, K shard clients, a reconnecting worker
+    # pool) must queue, not wedge.
+    rpc.serve(service, host, port, ready_event=ready_event,
+              stop_event=stop_event, authkey=authkey,
+              hooks=_ServiceRpcHooks(), loop=loop,
+              max_workers=max_workers, backlog=64)
 
 
 # ---------------------------------------------------------------------------
@@ -616,7 +460,8 @@ class ServiceClient:
     def __init__(self, address: str, authkey: bytes | None = None,
                  retry: RetryPolicy | None = None,
                  protocol: str | None = None,
-                 wire_opts: wire.WireOptions | None = None):
+                 wire_opts: wire.WireOptions | None = None,
+                 transport: "rpc.MuxConnection | None" = None):
         host, _, port = address.rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
         self._authkey = authkey if authkey is not None else _authkey()
@@ -632,8 +477,33 @@ class ServiceClient:
         #: negotiated per-connection: None = v1 pickle
         self._wire: wire.WireOptions | None = None
         self._lock = threading.Lock()
-        self._conn = Client(self.address,   # guarded_by: self._lock
-                            authkey=self._authkey)
+        #: optional shared multiplexed transport (parallel/rpc.py):
+        #: this client becomes one logical stream on the transport's
+        #: socket instead of owning a socket — K clients to one peer
+        #: then cost one fd and ONE reader thread between them.  The
+        #: transport already negotiated wire options per-connection;
+        #: against a non-mux server it silently hands back dedicated
+        #: sockets and this client behaves exactly as before.
+        self._transport = transport
+        self._connect()
+
+    def _connect(self) -> None:
+        """(Re)establish the underlying conn + negotiated options."""
+        if self._transport is not None:
+            with self._lock:
+                self._conn, pre = self._transport.connect_stream()
+            if pre is not None:  # mux stream: negotiation is inherited
+                if not self._want_v2:
+                    raise ValueError(
+                        "protocol='v1' cannot ride a multiplexed "
+                        "transport — mux streams are wire-v2 framed")
+                self._wire = pre
+                return
+        else:
+            with self._lock:
+                self._conn = Client(self.address,  # guarded_by: self._lock
+                                    authkey=self._authkey)
+                rpc.set_nodelay(self._conn)
         self._negotiate()
 
     # -- transport -----------------------------------------------------
@@ -669,9 +539,10 @@ class ServiceClient:
                 self._conn.close()
             except OSError:
                 pass
-            self._conn = Client(self.address, authkey=self._authkey)
-            # the negotiation is per-connection state — redo it
-        self._negotiate()
+        # the negotiation is per-connection (or per-transport) state —
+        # _connect redoes it; a dead mux transport is re-established
+        # by connect_stream inside
+        self._connect()
 
     def _rejoin(self) -> None:
         """Subclass hook: re-establish server-side session state after
@@ -842,12 +713,22 @@ class ShardedServiceClient:
     for :class:`ServiceClient`), and the vector clock's per-client max
     keeps a duplicate from reading as a new exchange."""
 
-    def __init__(self, shard_clients: list, kind: str, session_id: str):
+    def __init__(self, shard_clients: list, kind: str, session_id: str,
+                 transports: list | None = None):
         if not shard_clients:
             raise ValueError("need at least one shard client")
         self._shard_clients = list(shard_clients)
         self._kind = kind
         self._sid = str(session_id)
+        #: optional per-shard rpc.MuxConnection transports shared by
+        #: the data client and the fence client of each shard — one
+        #: socket per PEER where granted.  Safe precisely because the
+        #: selector loop routes shard_freeze/release (and the fenced
+        #:  read/write ops) to its control pool: a freeze-parked
+        #: mutation parks an executor worker, never the connection's
+        #: read loop, so the fence no longer needs its own SOCKET to
+        #: dodge head-of-line blocking — only its own stream.
+        self._transports = list(transports) if transports else None
         #: tags this router's mutations in every shard's vector clock
         self._client_id = uuid.uuid4().hex
         self._router_lock = make_lock("ShardedServiceClient._router_lock")
@@ -936,7 +817,9 @@ class ShardedServiceClient:
             c = self._fence_clients[i]
         if c is None:
             host, port = self._shard_clients[i].address
-            c = ServiceClient(f"{host}:{port}")
+            c = ServiceClient(f"{host}:{port}",
+                              transport=(self._transports[i]
+                                         if self._transports else None))
             with self._router_lock:
                 if self._fence_clients[i] is None:
                     self._fence_clients[i] = c
@@ -1132,6 +1015,9 @@ class ShardedServiceClient:
                 c.close()
         for c in self._shard_clients:
             c.close()
+        for t in self._transports or ():
+            if t is not None:
+                t.close()
 
 
 class RemoteEASGD(ServiceClient):
@@ -1147,8 +1033,8 @@ class RemoteEASGD(ServiceClient):
     """
 
     def __init__(self, address: str, params: PyTree | None, alpha: float,
-                 session_id: str = "default"):
-        super().__init__(address)
+                 session_id: str = "default", transport=None):
+        super().__init__(address, transport=transport)
         self._sid = str(session_id)
         self._alpha = float(alpha)
         # rebuild payload for a rejoin after a SERVICE restart: the
@@ -1187,8 +1073,8 @@ class RemoteASGD(ServiceClient):
 
     def __init__(self, address: str, params: PyTree | None, opt_cfg: dict,
                  opt_state: PyTree | None = None,
-                 session_id: str = "default"):
-        super().__init__(address)
+                 session_id: str = "default", transport=None):
+        super().__init__(address, transport=transport)
         self._sid = str(session_id)
         self._opt_cfg = dict(opt_cfg)
         # rebuild payload: latest known CENTER (init params, refreshed
@@ -1271,6 +1157,10 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", default=None,
                     help="jax platform for the service's merge arithmetic "
                          "(e.g. 'cpu' so the service never claims a chip)")
+    ap.add_argument("--loop", default=None,
+                    choices=("selector", "threaded"),
+                    help="RPC substrate (parallel/rpc.py; default "
+                         "$THEANOMPI_TPU_RPC_LOOP or 'selector')")
     args = ap.parse_args(argv)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -1286,7 +1176,7 @@ def main(argv=None) -> int:
     with monitor.session(stall_after=float("inf"),
                          name=f"service{os.getpid()}"):
         monitor.progress(phase="serving")
-        serve(args.host, args.port)
+        serve(args.host, args.port, loop=args.loop)
     return 0
 
 

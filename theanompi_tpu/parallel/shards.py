@@ -184,6 +184,18 @@ class ShardParamService(ParamService):
     MUT_OPS = {"shard_exchange": "easgd_exchange",
                "shard_push_pull": "asgd_push_pull"}
 
+    #: RPC-substrate control-pool routing (parallel/rpc.py): during a
+    #: fence, frozen mutations legitimately PARK their executor
+    #: workers in _admit — freeze/release and the fenced read/write
+    #: ops must run on the control pool or the fence would starve
+    #: behind the very mutations it holds back (the pool-level form of
+    #: the dedicated-fence-connection rationale in docs/DESIGN.md)
+    RPC_CONTROL_OPS = ParamService.RPC_CONTROL_OPS | frozenset({
+        "shard_freeze", "shard_release", "shard_info",
+        "easgd_get_center", "asgd_get_center", "asgd_get_opt_state",
+        "asgd_set_lr",
+    })
+
     def __init__(self, shard_index: int = 0):
         super().__init__()
         self.shard_index = int(shard_index)
@@ -334,6 +346,22 @@ def serve_shard(host: str = "0.0.0.0", port: int = 0,
 # ---------------------------------------------------------------------------
 
 
+def _shard_transports(addresses: Sequence[str]) -> list | None:
+    """One multiplexed transport per shard peer
+    (``THEANOMPI_TPU_SHARD_MUX=1``): the shard's session client and
+    its fence control client become two streams on ONE socket —
+    halving the router's fd count — which the selector loop's
+    control-pool routing of ``shard_freeze``/``shard_release`` makes
+    deadlock-free (see ``ShardedServiceClient``).  Off by default;
+    against a non-mux server the transports silently degrade to
+    dedicated sockets."""
+    if os.environ.get("THEANOMPI_TPU_SHARD_MUX", "0") != "1":
+        return None
+    from theanompi_tpu.parallel.rpc import MuxConnection
+
+    return [MuxConnection(addr) for addr in addresses]
+
+
 class _ShardEASGD(RemoteEASGD):
     """One shard's session client: a :class:`RemoteEASGD` whose tree is
     this shard's sub-list of leaves.  Inherits the whole
@@ -413,10 +441,14 @@ class ShardedEASGD(ShardedServiceClient):
         self._plan = _TreePlan(len(addresses))
         subs = (self._plan.split(_np(jax.device_get(params)))
                 if params is not None else [None] * len(addresses))
+        transports = _shard_transports(addresses)
         clients = [_ShardEASGD(addr, sub, alpha=alpha,
-                               session_id=session_id)
-                   for addr, sub in zip(addresses, subs)]
-        super().__init__(clients, "easgd", session_id)
+                               session_id=session_id, transport=tr)
+                   for addr, sub, tr in zip(addresses, subs,
+                                            transports or
+                                            [None] * len(addresses))]
+        super().__init__(clients, "easgd", session_id,
+                         transports=transports)
 
     def exchange(self, worker_params: PyTree) -> PyTree:
         subs = self._plan.split(worker_params)
@@ -476,10 +508,14 @@ class ShardedASGD(ShardedServiceClient):
         self._plan = _TreePlan(len(addresses))
         subs = (self._plan.split(_np(jax.device_get(params)))
                 if params is not None else [None] * len(addresses))
+        transports = _shard_transports(addresses)
         clients = [_ShardASGD(addr, sub, dict(opt_cfg),
-                              session_id=session_id)
-                   for addr, sub in zip(addresses, subs)]
-        super().__init__(clients, "asgd", session_id)
+                              session_id=session_id, transport=tr)
+                   for addr, sub, tr in zip(addresses, subs,
+                                            transports or
+                                            [None] * len(addresses))]
+        super().__init__(clients, "asgd", session_id,
+                         transports=transports)
 
     def push_pull(self, grads: PyTree) -> PyTree:
         subs = self._plan.split(grads)

@@ -552,6 +552,32 @@ def recv_msg(conn, opts: WireOptions | None = None,
     return msg
 
 
+def account_send(stats: WireStats) -> None:
+    """Send-side byte accounting for a frame encoded with
+    :func:`encode_frame` but written by a caller-owned transport (the
+    selector loop's scatter-gather path) — same series as
+    :func:`send_msg`."""
+    if monitor.enabled():
+        monitor.inc("service/wire_bytes_pre", stats.pre_bytes, dir="send")
+        monitor.inc("service/wire_bytes_post", stats.post_bytes,
+                    dir="send")
+        monitor.set_gauge("service/wire_compression_ratio", stats.ratio,
+                          dir="send")
+
+
+def account_recv(msg: Any, head_len: int, post: int) -> None:
+    """Recv-side byte accounting for a frame decoded with
+    :func:`decode_frame` from caller-received chunks — same series as
+    :func:`recv_msg`."""
+    if monitor.enabled():
+        pre = head_len
+        for a in _iter_arrays(msg):
+            pre += a.nbytes
+        monitor.inc("service/wire_bytes_pre", pre, dir="recv")
+        monitor.inc("service/wire_bytes_post", post + head_len,
+                    dir="recv")
+
+
 def _iter_arrays(obj: Any):
     if isinstance(obj, np.ndarray):
         yield obj
@@ -579,10 +605,21 @@ def hello_payload(opts: WireOptions) -> dict:
             "dtype": opts.dtype}
 
 
-def accept_hello(payload: Any) -> tuple[WireOptions, dict]:
+def accept_hello(payload: Any, allow_mux: bool = False
+                 ) -> tuple[WireOptions, dict, bool]:
     """Server side: validate a hello payload, returning the negotiated
-    options and the reply dict.  Unknown/newer options degrade to the
-    safe defaults rather than failing the connection."""
+    options, the reply dict, and whether connection multiplexing was
+    granted.  Unknown/newer options degrade to the safe defaults
+    rather than failing the connection.
+
+    ``mux`` (``parallel/rpc.py``): a client may request stream
+    multiplexing — many logical request/reply streams framed over one
+    socket — by adding ``"mux": True`` to its hello.  Only a server
+    whose loop can demultiplex (the selector loop) passes
+    ``allow_mux=True``; everyone else omits ``mux`` from the reply and
+    the client falls back to one socket per stream, so an old client
+    (which never sends the key) and an old server (which never echoes
+    it) both keep working byte-compatibly."""
     if not isinstance(payload, dict):
         raise WireProtocolError(f"malformed wire_hello: {payload!r}")
     version = payload.get("version")
@@ -599,4 +636,8 @@ def accept_hello(payload: Any) -> tuple[WireOptions, dict]:
     # the pickle escape stays OFF for frames the server decodes: an
     # authenticated-but-hostile peer must not reach pickle.loads
     opts = WireOptions(compression=comp, dtype=dtype, allow_pickle=False)
-    return opts, hello_payload(opts)
+    mux = bool(allow_mux and payload.get("mux"))
+    reply = hello_payload(opts)
+    if mux:
+        reply["mux"] = True
+    return opts, reply, mux

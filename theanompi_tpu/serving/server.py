@@ -1,10 +1,10 @@
 """Multi-replica inference server + wire client.
 
-The transport is the proven ``parallel/service.py`` pattern —
-``multiprocessing.connection`` length-prefixed pickle with HMAC auth
-(NO default key; ``THEANOMPI_TPU_SERVICE_KEY`` gates both ends), one
-handler thread per connection, typed error names riding the ``err``
-reply prefix — so everything learned there (reconnect-with-backoff
+The transport is the shared RPC substrate (``parallel/rpc.py``) —
+selector event loop, HMAC auth with a handshake deadline (NO default
+key; ``THEANOMPI_TPU_SERVICE_KEY`` gates both ends), negotiated
+wire-v2 framing, typed error names riding the ``err`` reply prefix —
+so everything learned on the param service (reconnect-with-backoff
 clients, fast-failing server errors) carries over to serving.
 
 Topology: one :class:`InferenceServer` owns N :class:`Replica`\\ s.
@@ -38,13 +38,13 @@ import argparse
 import os
 import threading
 import time
-from multiprocessing.connection import Client, Connection, Listener
 from typing import Any
 
 import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_lock
+from theanompi_tpu.parallel import rpc
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.serving.batcher import (
     BatchPolicy,
@@ -407,6 +407,21 @@ class InferenceServer:
 
     # -- wire dispatch ---------------------------------------------------
 
+    def rpc_max_workers(self) -> int:
+        """Executor width for the RPC substrate: enough workers that
+        every admissible request (the batchers' bounded queues + one
+        executing batch per replica) can block in a handler
+        concurrently, plus slack so O(1) ``Overloaded`` rejections
+        never queue behind parked handlers."""
+        n = len(self.replicas)
+        if self.decode:
+            per = max((getattr(r.batcher.policy, "max_pending", 32)
+                       + getattr(r.session.cfg, "max_seqs", 8))
+                      for r in self.replicas)
+        else:
+            per = self.policy.max_queue + self.policy.max_batch
+        return n * per + 8
+
     def handle(self, op: str, *args):
         if op == "infer":
             (x,) = args
@@ -425,97 +440,64 @@ class InferenceServer:
         raise ValueError(f"unknown op {op!r}")
 
 
+class _ServingRpcHooks(rpc.RpcHooks):
+    """The inference plane's seams into the shared RPC substrate
+    (``parallel/rpc.py``): literal ``serving/*`` series names (the
+    TM403/404 docs-coverage contract) and the ``serve_rpc`` fault
+    site.  Migrating onto the substrate also bought this plane wire-v2
+    framing — request/reply arrays now travel as zero-copy buffers
+    instead of pickles — with clients unchanged
+    (:class:`InferenceClient` always negotiated; the old loop just
+    answered "unknown op")."""
+
+    plane = "serving"
+
+    def on_connect(self) -> None:
+        monitor.add_gauge("serving/clients", 1.0)
+
+    def on_disconnect(self) -> None:
+        monitor.add_gauge("serving/clients", -1.0)
+
+    def on_request(self, op: str, ms: float) -> None:
+        monitor.inc("serving/requests_total", op=op)
+        monitor.observe("serving/rpc_ms", ms, op=op)
+        monitor.progress(phase="serving")
+
+    def on_error(self, op: str) -> None:
+        monitor.inc("serving/errors_total", op=op)
+
+    def on_negotiate(self, opts) -> None:
+        monitor.inc("serving/wire_negotiations_total",
+                    compression=opts.compression, dtype=opts.dtype)
+
+    def fire(self, op: str) -> None:
+        # fault plane: 'raise' rejects this RPC (the client sees the
+        # typed err), 'delay' adds latency — both exercised with the
+        # server LIVE, which is the point
+        faults.fire("serve_rpc", op=op)
+
+
 def serve(server: InferenceServer, host: str = "0.0.0.0",
           port: int = DEFAULT_PORT,
           ready_event: threading.Event | None = None,
           stop_event: threading.Event | None = None,
-          authkey: bytes | None = None) -> None:
-    """Accept loop (one handler thread per connection) until a
-    ``shutdown`` op or ``stop_event`` — the parallel/service.py shape,
-    with the serving ops and the ``serve_rpc`` fault site."""
+          authkey: bytes | None = None,
+          loop: str | None = None) -> None:
+    """The shared RPC substrate over an :class:`InferenceServer` until
+    a ``shutdown`` op or ``stop_event`` (``parallel/rpc.py``; same
+    loops/knobs as every other plane).  The executor pool is sized by
+    the plane's own admission bound — an ``infer``/``generate``
+    handler legitimately blocks until its batch completes, and the
+    batchers' bounded queues already cap how many can be in flight;
+    past that bound requests get their O(1) typed ``Overloaded``."""
     from theanompi_tpu.parallel.service import _authkey
 
-    if stop_event is None:
-        stop_event = threading.Event()
     if authkey is None:
         authkey = _authkey(generate=True)
-    listener = Listener((host, port), authkey=authkey)
-    if ready_event is not None:
-        ready_event.set()
-
-    def handle_conn(conn: Connection):
-        monitor.add_gauge("serving/clients", 1.0)
-        try:
-            with conn:
-                while True:
-                    try:
-                        msg = conn.recv()
-                    except (EOFError, OSError):
-                        return
-                    if not isinstance(msg, tuple) or not msg:
-                        monitor.inc("serving/errors_total",
-                                    op="malformed")
-                        conn.send(("err", "malformed request"))
-                        continue
-                    op, *args = msg
-                    if op == "shutdown":
-                        conn.send(("ok", None))
-                        stop_event.set()
-                        try:  # unblock accept() so the loop exits
-                            Client((host if host != "0.0.0.0"
-                                    else "127.0.0.1", port),
-                                   authkey=authkey).close()
-                        except OSError:
-                            pass
-                        return
-                    t0 = time.monotonic()
-                    try:
-                        # fault plane: 'raise' rejects this RPC (the
-                        # client sees the typed err), 'delay' adds
-                        # latency — both exercised with the server
-                        # LIVE, which is the point
-                        faults.fire("serve_rpc", op=op)
-                        result = server.handle(op, *args)
-                    except Exception as e:  # surfaced client-side
-                        monitor.inc("serving/errors_total", op=op)
-                        conn.send(("err", f"{type(e).__name__}: {e}"))
-                        continue
-                    try:
-                        conn.send(("ok", result))
-                    except (EOFError, OSError):
-                        return  # peer gone; nothing to tell it
-                    except Exception as e:
-                        # reply failed to SERIALIZE (send pickles
-                        # before writing, so no bytes hit the wire
-                        # yet) — the client must still get a
-                        # diagnostic, not a bare EOFError
-                        # (parallel/service.py's loop has the same
-                        # branch)
-                        monitor.inc("serving/errors_total", op=op)
-                        conn.send(("err", f"{type(e).__name__}: {e}"))
-                        continue
-                    monitor.inc("serving/requests_total", op=op)
-                    monitor.observe("serving/rpc_ms",
-                                    (time.monotonic() - t0) * 1e3,
-                                    op=op)
-                    monitor.progress(phase="serving")
-        finally:
-            monitor.add_gauge("serving/clients", -1.0)
-
-    from multiprocessing import AuthenticationError
-
-    with listener:
-        while not stop_event.is_set():
-            try:
-                conn = listener.accept()
-            except AuthenticationError:
-                continue  # a bad-key peer must not kill the server
-            except OSError:
-                if stop_event.is_set():
-                    return
-                raise
-            threading.Thread(target=handle_conn, args=(conn,),
-                             daemon=True).start()
+    rpc.serve(server, host, port, ready_event=ready_event,
+              stop_event=stop_event, authkey=authkey,
+              hooks=_ServingRpcHooks(), loop=loop,
+              max_workers=server.rpc_max_workers())
 
 
 # ---------------------------------------------------------------------------

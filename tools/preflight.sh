@@ -335,7 +335,26 @@ PYEOF
   INGEST_RC=$?
   rm -rf "$INGESTDIR"
   echo "ingest smoke rc=$INGEST_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ]; then
+  echo "## rpc smoke (concurrent-connection scaling on the selector event plane, docs/DESIGN.md 'RPC substrate')"
+  # the event-plane vertical end-to-end: a REAL service process
+  # (selector loop, pinned to one core) fronting hundreds of
+  # concurrent authenticated connections, every one with a pull in
+  # flight.  The gate asserts flat per-connection p99 across the
+  # scaling points, the >=10x recovery of the committed PR-9
+  # GIL-convoy baseline at the 12-client point, and the monitor JSONL
+  # evidence (rpc/connections_total + service/requests_total from the
+  # server process) — tools/bench_rpc.py --smoke, exit 1 on any miss.
+  # 200-conn top point here (preflight's >=200-client bar); the
+  # committed artifacts/BENCH_rpc_smoke.json carries the full
+  # 1000-connection run.
+  RPCDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu \
+    python tools/bench_rpc.py --smoke --conns 8,200 --dur 3 \
+      --out "$RPCDIR/BENCH_rpc_smoke.json"
+  RPC_RC=$?
+  rm -rf "$RPCDIR"
+  echo "rpc smoke rc=$RPC_RC"
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
