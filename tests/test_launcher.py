@@ -95,7 +95,8 @@ def test_parser_serve_decode_mode(monkeypatch, tmp_path):
     assert seen["decode"] is True
     assert seen["decode_opts"] == {
         "page_size": 4, "pages_per_seq": 2, "max_seqs": 16,
-        "max_pending": 64, "prefill_buckets": (8, 32)}
+        "max_pending": 64, "prefill_buckets": (8, 32),
+        "prefix_cache": True}
     # default: decode off, opts None
     _run(p.parse_args(["SERVE", "--export-dir", "/tmp/exp"]),
          multihost=False)

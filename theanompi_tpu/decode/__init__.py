@@ -7,8 +7,9 @@ where every request is a loop whose state (the KV cache) must live on
 the device between steps.  This package adds that loop:
 
 * ``kvcache``   — paged/ring KV cache: one fixed page pool per
-  replica, per-sequence page tables, ring eviction past the context
-  window (pure-functional JAX state);
+  replica, REFCOUNTED per-sequence page tables (copy-on-write page
+  sharing + the cross-request ``PrefixCache``), ring eviction past
+  the context window (pure-functional JAX state);
 * ``model``     — cached-attention forward sharing weights with the
   training ``Block`` (the exported ``TransformerLMNet`` params,
   applied through the same flax submodules);
@@ -32,7 +33,11 @@ Wire surface: the inference server's ``generate`` op
     tokens = InferenceClient("host:45900").generate(prompt, max_new=64)
 """
 
-from theanompi_tpu.decode.kvcache import CacheConfig, PagePool
+from theanompi_tpu.decode.kvcache import (
+    CacheConfig,
+    PagePool,
+    PrefixCache,
+)
 from theanompi_tpu.decode.model import full_forward
 from theanompi_tpu.decode.scheduler import (
     ContinuousBatcher,
@@ -45,7 +50,7 @@ from theanompi_tpu.decode.session import (
 )
 
 __all__ = [
-    "CacheConfig", "PagePool", "full_forward", "ContinuousBatcher",
-    "DecodePolicy", "DecodeReplica", "DecodeSession",
-    "default_prefill_buckets",
+    "CacheConfig", "PagePool", "PrefixCache", "full_forward",
+    "ContinuousBatcher", "DecodePolicy", "DecodeReplica",
+    "DecodeSession", "default_prefill_buckets",
 ]

@@ -1,5 +1,14 @@
 """Paged/ring KV-cache — pure-functional JAX state + a host-side pool.
 
+Pages are REFCOUNTED: a per-sequence page table may alias pages owned
+by other sequences or by the cross-request :class:`PrefixCache` (a
+shared system prompt prefills once and is mapped read-only into every
+stream that starts with it).  Writes stay sound through host-side
+copy-on-write — before any jitted step writes a slot, the session
+replaces every page it touches whose refcount is > 1 with a private
+device copy (``DecodeSession._cow_prepare``), so the device arrays
+themselves never need to know about sharing.
+
 The decode subsystem's device state is ONE fixed page pool per replica
 (``k_pages``/``v_pages``: ``(layers, n_pages, page_size, heads,
 d_head)``), never resized and never reshaped: every jitted decode step
@@ -109,6 +118,22 @@ def cache_mask(lengths, window: int):
     return (pos >= 0) & (pos > lens - window)
 
 
+def chunk_cache_mask(lengths, chunk: int, window: int):
+    """(S, chunk, window) bool: ring slots the chunk's ``i``-th new
+    token (absolute position ``length + i``) may attend — the
+    per-query generalization of :func:`cache_mask` for the multi-token
+    verify/extend programs.  Slot contents are PRE-write (the chunk's
+    own tokens attend each other inside the chunk, not via the ring),
+    so the stored position per slot is computed from ``lengths``
+    alone; each query just tightens the sliding window by its own
+    offset."""
+    pos = stored_positions(lengths, window)            # (S, W)
+    qpos = (lengths.astype(jnp.int32)[:, None]
+            + jnp.arange(chunk, dtype=jnp.int32)[None, :])  # (S, C)
+    return ((pos[:, None, :] >= 0)
+            & (pos[:, None, :] > qpos[:, :, None] - window))
+
+
 def ring_from_prompt(kv, length, window: int):
     """Scatter one prompt's per-position K or V into its ring layout.
 
@@ -147,25 +172,44 @@ def gather_layer(pages, page_rows):
     return g.reshape(s, pps * pages.shape[1], *pages.shape[2:])
 
 
-def write_token_all(pages, page_rows, lengths, active, kv):
-    """Write each sequence's NEW token (position ``length``) into the
-    pool at ring slot ``length % window``, all layers in one scatter.
+def write_tokens_all(pages, page_rows, lengths, counts, kv):
+    """Write the first ``counts[s]`` of C new tokens per sequence
+    (positions ``length .. length+counts-1``) into the pool, all
+    layers in one scatter.
 
     ``pages``: the full pool (L, n_pages, page_size, H, D); ``kv``:
-    (L, S, H, D) — each layer's new-token K or V.  Slot/page math is
-    shared across layers (same sequences), so the write is one batched
-    ``.at[:, page, off].set``; inactive (bucket-padding) rows are
-    routed to page id ``n_pages`` and dropped by the scatter, so
-    padding can never clobber a live page.
+    (L, S, C, H, D) — each layer's per-chunk-token K or V; ``counts``:
+    (S,) int — how many leading chunk tokens are actually written (the
+    speculative ACCEPT count, or the real suffix length of a padded
+    extend-prefill chunk; 0 for an inactive bucket-padding row).
+    Slot/page math is shared across layers, so the write is one
+    batched ``.at[:, page, off].set``; tokens past a sequence's count
+    are routed to page id ``n_pages`` and dropped by the scatter —
+    which is exactly how REJECTED draft tokens never reach the cache
+    (no rollback needed: nothing was written).  Requires C <= window
+    so a chunk's positions land on distinct slots.
     """
     page_size = pages.shape[2]
     window = page_rows.shape[1] * page_size
-    slot = jnp.mod(lengths.astype(jnp.int32), window)
-    page = jnp.take_along_axis(page_rows,
-                               (slot // page_size)[:, None], axis=1)[:, 0]
-    page = jnp.where(active, page, pages.shape[1])
+    c = kv.shape[2]
+    i = jnp.arange(c, dtype=jnp.int32)[None, :]                  # (1, C)
+    pos = lengths.astype(jnp.int32)[:, None] + i                 # (S, C)
+    slot = jnp.mod(pos, window)
+    page = jnp.take_along_axis(page_rows, slot // page_size, axis=1)
+    page = jnp.where(i < counts.astype(jnp.int32)[:, None], page,
+                     pages.shape[1])
     off = jnp.mod(slot, page_size)
     return pages.at[:, page, off].set(kv, mode="drop")
+
+
+def write_token_all(pages, page_rows, lengths, active, kv):
+    """Write each sequence's NEW token (position ``length``) into the
+    pool at ring slot ``length % window`` — the one-token decode step,
+    expressed as a chunk of 1 (:func:`write_tokens_all`); inactive
+    (bucket-padding) rows write nothing."""
+    counts = jnp.where(active, 1, 0)
+    return write_tokens_all(pages, page_rows, lengths, counts,
+                            kv[:, :, None])
 
 
 # ---------------------------------------------------------------------------
@@ -174,16 +218,24 @@ def write_token_all(pages, page_rows, lengths, active, kv):
 
 
 class PagePool:
-    """Free-list page allocator for one replica's pool.
+    """Refcounted free-list page allocator for one replica's pool.
 
     Owned by the replica's single scheduler thread
     (decode/scheduler.py) — not thread-safe by design, the same
     single-owner discipline as the session's host-side sequence state.
+
+    A page's refcount counts every page-table slot and every
+    :class:`PrefixCache` entry holding it; a page returns to the free
+    list only when the LAST reference drops, which is what lets a
+    shared prefix page outlive the sequence that prefilled it.  A
+    refcount > 1 marks the page read-only for writers — the session's
+    copy-on-write check (``DecodeSession._cow_prepare``).
     """
 
     def __init__(self, cfg: CacheConfig):
         self.cfg = cfg
         self._free = list(range(cfg.n_pages - 1, -1, -1))
+        self._refs = np.zeros(cfg.n_pages, np.int32)
 
     @property
     def free_pages(self) -> int:
@@ -193,19 +245,168 @@ class PagePool:
     def used_fraction(self) -> float:
         return 1.0 - len(self._free) / self.cfg.n_pages
 
-    def alloc_seq(self) -> np.ndarray | None:
-        """One sequence's page row (``pages_per_seq`` ids), or None
-        when the pool cannot cover it."""
-        n = self.cfg.pages_per_seq
+    def refcount(self, page: int) -> int:
+        return int(self._refs[page])
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` fresh pages at refcount 1 each, or None when the free
+        list cannot cover it (the caller may relieve pressure by
+        evicting PrefixCache entries and retry)."""
         if len(self._free) < n:
             return None
         ids = [self._free.pop() for _ in range(n)]
-        return np.asarray(ids, np.int32)
+        self._refs[ids] = 1
+        return ids
 
-    def free_seq(self, page_row: np.ndarray) -> None:
-        for p in page_row.tolist():
+    def alloc_seq(self) -> np.ndarray | None:
+        """One sequence's page row (``pages_per_seq`` ids), or None
+        when the pool cannot cover it."""
+        ids = self.alloc(self.cfg.pages_per_seq)
+        return None if ids is None else np.asarray(ids, np.int32)
+
+    def incref(self, pages) -> None:
+        """Adopt already-allocated pages (a prefix-cache hit aliasing
+        shared pages into a new sequence's table, or a cache entry
+        registering a prefill's pages)."""
+        for p in np.asarray(pages, np.int64).reshape(-1).tolist():
+            if not 0 <= p < self.cfg.n_pages:
+                raise ValueError(f"incref of foreign page id {p}")
+            if self._refs[p] < 1:
+                raise ValueError(f"incref of free page {p}")
+            self._refs[p] += 1
+
+    def decref(self, pages) -> int:
+        """Drop one reference per page; pages reaching zero return to
+        the free list.  Returns how many pages were freed."""
+        freed = 0
+        for p in np.asarray(pages, np.int64).reshape(-1).tolist():
             if not 0 <= p < self.cfg.n_pages:
                 raise ValueError(f"freeing foreign page id {p}")
-            if p in self._free:
+            if self._refs[p] < 1:
                 raise ValueError(f"double free of page {p}")
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
+    def free_seq(self, page_row: np.ndarray) -> None:
+        self.decref(page_row)
+
+
+class _PrefixEntry:
+    __slots__ = ("pages", "n_tokens")
+
+    def __init__(self, pages: list[int], n_tokens: int):
+        self.pages = pages
+        self.n_tokens = n_tokens
+
+
+class PrefixCache:
+    """Cross-request prefix cache (scheduler-thread owned, like the
+    pool it feeds).
+
+    Maps the BYTES of a page-aligned prompt prefix to the page ids
+    holding those positions' K/V — exact-match keys, so a hash
+    collision can never alias two different prompts.  An admit whose
+    prompt starts with a cached prefix aliases the shared pages into
+    its page table (``PagePool.incref``) and prefills only the
+    suffix; the first write that would land on a shared page triggers
+    copy-on-write.  Entries hold their own refcount on every page, so
+    a shared prefix outlives the sequence that prefilled it; eviction
+    is LRU under allocation pressure (``evict_lru``) and rides the
+    pool's free-list discipline — a page only truly frees when no
+    live sequence aliases it either.
+
+    Sharing is sound only while slot == position (one un-wrapped ring
+    lap): prompts longer than the window prefill through eviction and
+    are neither cached nor matched.
+    """
+
+    def __init__(self, pool: PagePool, window: int):
+        self.pool = pool
+        self.window = int(window)
+        self.page_size = int(pool.cfg.page_size)
+        #: insertion-ordered = LRU order (move_to_end on hit)
+        self._entries: dict[bytes, _PrefixEntry] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def cached_pages(self) -> int:
+        """Distinct pages referenced by at least one entry."""
+        return len({p for e in self._entries.values() for p in e.pages})
+
+    def evictable_pages(self) -> int:
+        """Pages that would return to the free list if every entry
+        were evicted — pages ONLY the cache still holds (refcount ==
+        the number of entries referencing them)."""
+        held: dict[int, int] = {}
+        for e in self._entries.values():
+            for p in e.pages:
+                held[p] = held.get(p, 0) + 1
+        return sum(1 for p, n in held.items()
+                   if self.pool.refcount(p) == n)
+
+    def _max_pages(self, prompt_len: int) -> int:
+        """Longest page-aligned PROPER prefix (>= 1 suffix token must
+        remain: its logits seed the first generated token) that fits
+        one ring lap."""
+        if prompt_len > self.window:
+            return 0
+        return (prompt_len - 1) // self.page_size
+
+    def lookup(self, prompt: np.ndarray) -> _PrefixEntry | None:
+        """Longest cached page-aligned proper prefix of ``prompt``
+        (MRU-bumped), or None.  The caller adopts the entry's pages
+        with ``PagePool.incref`` BEFORE any allocation that could
+        trigger eviction."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        for q in range(self._max_pages(prompt.shape[0]), 0, -1):
+            key = prompt[:q * self.page_size].tobytes()
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.pop(key)
+                self._entries[key] = e      # move to MRU
+                self.hits += 1
+                return e
+        self.misses += 1
+        return None
+
+    def insert(self, prompt: np.ndarray, page_row: np.ndarray) -> int:
+        """Register every page-aligned proper prefix of a just-
+        prefilled prompt (nested entries make partial-overlap hits
+        possible); each entry increfs the pages it references.
+        Returns the number of entries added."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        added = 0
+        for q in range(1, self._max_pages(prompt.shape[0]) + 1):
+            key = prompt[:q * self.page_size].tobytes()
+            if key in self._entries:
+                continue
+            pages = [int(p) for p in page_row[:q]]
+            self.pool.incref(pages)
+            self._entries[key] = _PrefixEntry(pages, q * self.page_size)
+            added += 1
+        return added
+
+    def evict_lru(self) -> int:
+        """Drop the least-recently-used entry; returns pages actually
+        freed (0 both when the cache is empty and when every page is
+        still aliased by a live sequence or a longer entry)."""
+        if not self._entries:
+            return 0
+        key = next(iter(self._entries))
+        e = self._entries.pop(key)
+        self.evictions += 1
+        return self.pool.decref(e.pages)
+
+    def evict_all(self) -> int:
+        freed = 0
+        while self._entries:
+            freed += self.evict_lru()
+        return freed

@@ -108,6 +108,56 @@ def full_forward(params, tokens, n_layers: int, n_heads: int, dtype,
     return final_logits(params, x, dtype), ks, vs
 
 
+def chunk_block(bp, x, k_cache, v_cache, ring_mask, n_heads: int,
+                dtype, window: int | None = None):
+    """One block for a CHUNK of C new tokens per sequence against the
+    ring — the multi-token generalization of :func:`decode_block`,
+    shared by the speculative VERIFY step (C = k drafted tokens + the
+    pending one) and the prefix-cache EXTEND prefill (C = the padded
+    prompt suffix).
+
+    ``x``: (S, C, D) the chunk's residual stream, token ``i`` at
+    absolute position ``length + i``; ``k_cache``/``v_cache``:
+    (S, W, H, Dh) gathered ring (PRE-write); ``ring_mask``:
+    (S, C, W) per-query valid-slot mask
+    (decode/kvcache.chunk_cache_mask).  Each chunk token attends the
+    masked ring PLUS the chunk's earlier tokens and itself (causal
+    within the chunk, window-limited when ``window`` is given — their
+    K/V are appended as C extra keys, exactly the positions the ring
+    does not hold yet).  Returns (x_out (S, C, D),
+    k_new (S, C, H, Dh), v_new).
+    """
+    s_, c, d = x.shape
+    d_head = d // n_heads
+    h = _ln(bp["LayerNorm_0"], x, dtype)
+    shape = (s_, c, n_heads, d_head)
+    q = _dense(bp["q_proj"], h, dtype).reshape(shape)
+    k_new = _dense(bp["k_proj"], h, dtype).reshape(shape)
+    v_new = _dense(bp["v_proj"], h, dtype).reshape(shape)
+    scale = d_head ** -0.5
+    sc = block_scores(q, k_cache, scale)               # (S, H, C, W)
+    sc = jnp.where(ring_mask[:, None], sc, _MASK_NEG)
+    self_sc = block_scores(q, k_new, scale)            # (S, H, C, C)
+    ci = jnp.arange(c, dtype=jnp.int32)
+    cmask = ci[None, :] <= ci[:, None]
+    if window is not None:
+        cmask = cmask & (ci[:, None] - ci[None, :] < window)
+    self_sc = jnp.where(cmask[None, None], self_sc, _MASK_NEG)
+    logits = jnp.concatenate([sc, self_sc], axis=-1)   # (S, H, C, W+C)
+    p = jax.nn.softmax(logits, axis=-1)
+    w = k_cache.shape[1]
+    o_cache = jnp.einsum("bhqk,bkhd->bqhd",
+                         p[..., :w].astype(v_cache.dtype), v_cache)
+    o_self = jnp.einsum("bhqk,bkhd->bqhd",
+                        p[..., w:].astype(v_new.dtype), v_new)
+    o = (o_cache + o_self).reshape(s_, c, d)
+    x = x + _dense(bp["o_proj"], o, dtype)
+    h2 = _ln(bp["LayerNorm_1"], x, dtype)
+    h2 = jax.nn.gelu(_dense(bp["mlp_up"], h2, dtype))
+    x = x + _dense(bp["mlp_down"], h2, dtype)
+    return x, k_new, v_new
+
+
 def decode_block(bp, x, k_cache, v_cache, mask, n_heads: int, dtype):
     """One block for ONE new token per sequence against the ring.
 

@@ -26,11 +26,22 @@ What carries over from ``DynamicBatcher`` unchanged:
   corpse (``DecodeReplica`` owns restart-from-export, exactly like
   ``Replica``).
 
+With a **draft session** (speculative decoding, docs/SERVING.md), the
+per-iteration step becomes a ROUND: one draft ``propose`` call (k
+greedy proposals), one bucketed target ``verify`` step (accept the
+longest matching prefix, k+1 tokens on a full accept), one draft
+``commit`` — still iteration-level, so admits/evicts interleave with
+speculative rounds exactly as with plain steps, and a draft that
+cannot be reloaded after a fault downgrades the replica to plain
+decode instead of costing availability.
+
 Telemetry: per-token inter-token latency (``decode/intertoken_ms`` —
 the serving SLO, not request latency), tokens/steps counters, active/
-pending gauges, cache occupancy and evictions — all in the monitor
-registry (docs/OBSERVABILITY.md) plus a host-side p50/p99 ring in
-``stats()`` for the bench tools.
+pending gauges, cache occupancy and evictions, speculative accept
+rate (``decode/accept_rate``, drafted/accepted counters) and
+prefix-cache hit/miss/eviction + copy-on-write counters — all in the
+monitor registry (docs/OBSERVABILITY.md) plus a host-side p50/p99
+ring in ``stats()`` for the bench tools.
 """
 
 from __future__ import annotations
@@ -62,6 +73,12 @@ class DecodePolicy:
     submit_timeout_s: float = 120.0
     #: greedy decode stops early on this token (None = length-only)
     eos_token: int | None = None
+    #: draft tokens per speculative round (used only when the replica
+    #: has a draft session; k drafts verify in ONE target step and the
+    #: verify's own argmax rides along, so a full accept advances a
+    #: stream k+1 tokens per step — docs/SERVING.md "Speculative
+    #: decode")
+    speculate_k: int = 4
 
 
 class _GenRequest:
@@ -90,19 +107,32 @@ class ContinuousBatcher:
     ``generate`` is the client-side entry (any thread)."""
 
     def __init__(self, session, policy: DecodePolicy | None = None,
-                 replica: int = 0, on_error=None):
+                 replica: int = 0, on_error=None, draft_session=None):
         self.session = session
         self.policy = policy or DecodePolicy()
         self.replica = int(replica)
         self._on_error = on_error
+        #: draft DecodeSession (speculative decoding) or None; owned
+        #: by the scheduler thread like the target session — a restart
+        #: that cannot reload the draft clears it (speculation off,
+        #: replica keeps serving)
+        self._draft = draft_session
+        if draft_session is not None:
+            k = int(self.policy.speculate_k)
+            for s, who in ((session, "target"), (draft_session, "draft")):
+                if not 1 <= k <= s.window - 1:
+                    raise ValueError(
+                        f"speculate_k {k} outside [1, window-1="
+                        f"{s.window - 1}] for the {who} session")
         self._pending: deque[_GenRequest] = deque()  # guarded_by: self._lock
         self._lock = make_lock("ContinuousBatcher._lock")
         self._cond = make_condition(self._lock)
         self._dead = False                           # guarded_by: self._lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        # scheduler-thread-owned live set: (request, session _Seq)
-        self._active: list[tuple[_GenRequest, object]] = []
+        # scheduler-thread-owned live set:
+        # (request, target _Seq, draft _Seq | None)
+        self._active: list[tuple[_GenRequest, object, object]] = []
         self._steps = 0
         # plain-int stats (torn reads of monotonic ints are harmless
         # for stats(), the DynamicBatcher convention)
@@ -116,6 +146,13 @@ class ContinuousBatcher:
         #: iteration-level-sharing proof the preflight smoke asserts
         self.shared_steps = 0
         self.max_concurrent = 0
+        #: speculative accounting (utils/token_accounting.py): drafted
+        #: = k per sequence per round, accepted = those the verify
+        #: step kept; emitted tokens ride the ordinary token counters
+        self.n_drafted = 0
+        self.n_draft_accepted = 0
+        #: last-seen cow_copies across both sessions (delta -> monitor)
+        self._cow_seen = 0
         self._intertoken_ms: deque[float] = deque(maxlen=4096)  # guarded_by: self._lock
 
     # -- lifecycle ------------------------------------------------------
@@ -142,12 +179,20 @@ class ContinuousBatcher:
             return not self._dead and not self._stop.is_set()
 
     def stats(self) -> dict:
+        from theanompi_tpu.utils.token_accounting import (
+            speculative_accounting,
+        )
+
         with self._lock:
             pending = len(self._pending)
             lat = (np.sort(np.asarray(self._intertoken_ms, np.float64))
                    if self._intertoken_ms else np.zeros((0,)))
         pick = (lambda q: float(lat[min(len(lat) - 1, int(q * len(lat)))])
                 if len(lat) else None)
+        pc = self.session.prefix_cache
+        # one-read snapshot: disable_speculation() nulls _draft on the
+        # scheduler thread while stats() runs on an RPC handler thread
+        draft = self._draft
         return {
             "replica": self.replica,
             "alive": self.alive,
@@ -165,6 +210,22 @@ class ContinuousBatcher:
             "intertoken_ms": {"p50": pick(0.50), "p99": pick(0.99),
                               "count": len(lat)},
             "compiles": dict(self.session.compiles),
+            "draft_compiles": (dict(draft.compiles)
+                               if draft is not None else None),
+            "speculative": draft is not None,
+            # one arithmetic with bench_lm/bench_serving: emitted
+            # tokens are the throughput axis; rejected drafts are
+            # compute, not output
+            "speculation": speculative_accounting(
+                self.n_tokens, self.n_drafted, self.n_draft_accepted),
+            "prefix_cache": (None if pc is None else {
+                "hits": pc.hits, "misses": pc.misses,
+                "evictions": pc.evictions, "entries": len(pc),
+                "cached_pages": pc.cached_pages,
+            }),
+            "cow_copies": (self.session.cow_copies
+                           + (draft.cow_copies
+                              if draft is not None else 0)),
         }
 
     # -- client side ----------------------------------------------------
@@ -248,11 +309,19 @@ class ContinuousBatcher:
                               replica=self.replica)
             return req
 
+    def _prefix_metrics(self) -> tuple[int, int, int]:
+        pc = self.session.prefix_cache
+        return (0, 0, 0) if pc is None else (pc.hits, pc.misses,
+                                             pc.evictions)
+
     def _admit(self) -> None:
         """Admit pending prompts into free slots — every iteration, so
-        the oldest waiter's deadline is one decode step away."""
+        the oldest waiter's deadline is one decode step away.  With a
+        draft session the prompt is admitted into BOTH caches (same
+        geometry, so a target admit implies draft capacity)."""
         while (len(self._active) < self.session.cfg.max_seqs
                 and self.session.can_admit()
+                and (self._draft is None or self._draft.can_admit())
                 and not self._stop.is_set()):
             req = self._take_pending()
             if req is None:
@@ -260,6 +329,7 @@ class ContinuousBatcher:
             if req.cancelled:
                 continue
             t0 = time.monotonic()
+            h0, m0, e0 = self._prefix_metrics()
             try:
                 seq, logits = self.session.admit(req.prompt)
             except Exception as e:
@@ -269,12 +339,33 @@ class ContinuousBatcher:
                     continue
                 self._abort_inflight(e, extra=[req])
                 return
+            dseq = None
+            if self._draft is not None:
+                try:
+                    dseq, _ = self._draft.admit(req.prompt)
+                except Exception as e:
+                    self.session.release(seq)
+                    if isinstance(e, ValueError):
+                        self._fail_requests([req], e)
+                        continue
+                    self._abort_inflight(e, extra=[req])
+                    return
+            h1, m1, e1 = self._prefix_metrics()
+            if h1 > h0:
+                monitor.inc("decode/prefix_cache_hits_total",
+                            h1 - h0, replica=self.replica)
+            if m1 > m0:
+                monitor.inc("decode/prefix_cache_misses_total",
+                            m1 - m0, replica=self.replica)
+            if e1 > e0:
+                monitor.inc("decode/prefix_cache_evictions_total",
+                            e1 - e0, replica=self.replica)
             monitor.observe("decode/prefill_ms",
                             (time.monotonic() - t0) * 1e3,
                             replica=self.replica)
             self.n_admitted += 1
             monitor.inc("decode/admitted_total", replica=self.replica)
-            self._active.append((req, seq))
+            self._active.append((req, seq, dseq))
             self.max_concurrent = max(self.max_concurrent,
                                       len(self._active))
             self._emit_token(req, int(np.argmax(logits)))
@@ -286,10 +377,13 @@ class ContinuousBatcher:
                           replica=self.replica)
 
     def _step(self) -> None:
+        if self._draft is not None:
+            self._spec_step()
+            return
         self._steps += 1
         t0 = time.monotonic()
-        reqs = [r for r, _ in self._active]
-        seqs = [s for _, s in self._active]
+        reqs = [r for r, _, _ in self._active]
+        seqs = [s for _, s, _ in self._active]
         tokens = np.asarray(
             [r.out[-1] if r.out else int(r.prompt[-1]) for r in reqs],
             np.int32)
@@ -309,9 +403,94 @@ class ContinuousBatcher:
                           replica=self.replica)
         if len(self._active) >= 2:
             self.shared_steps += 1
-        for i, (req, _) in enumerate(self._active):
+        for i, (req, _, _) in enumerate(self._active):
             self._emit_token(req, int(np.argmax(logits[i])))
+        self._emit_cow_delta()
         self._evict_finished()
+
+    def _spec_step(self) -> None:
+        """One speculative round for every active sequence: k draft
+        proposals (one draft program call), ONE bucketed target verify
+        step, then the draft cache commits the accepted prefix.  Every
+        sequence advances by its accept count + 1 (the verify step's
+        own argmax token rides along), so a full accept yields k+1
+        tokens for one target step."""
+        self._steps += 1
+        k = int(self.policy.speculate_k)
+        t0 = time.monotonic()
+        reqs = [r for r, _, _ in self._active]
+        seqs = [s for _, s, _ in self._active]
+        dseqs = [d for _, _, d in self._active]
+        pending = np.asarray(
+            [r.out[-1] if r.out else int(r.prompt[-1]) for r in reqs],
+            np.int32)
+        try:
+            faults.fire("decode_step", replica=self.replica,
+                        step=self._steps)
+            drafts = self._draft.propose(dseqs, pending, k)
+            y, counts = self.session.verify(seqs, pending, drafts)
+            self._draft.commit(dseqs, counts)
+        except Exception as e:
+            self._abort_inflight(e)
+            return
+        self.n_steps += 1
+        monitor.inc("decode/steps_total", replica=self.replica)
+        monitor.observe("decode/step_ms",
+                        (time.monotonic() - t0) * 1e3,
+                        replica=self.replica)
+        monitor.set_gauge("serving/replica_heartbeat", time.time(),
+                          replica=self.replica)
+        if len(self._active) >= 2:
+            self.shared_steps += 1
+        for i, (req, _, _) in enumerate(self._active):
+            accepted = int(counts[i]) - 1
+            self.n_drafted += k
+            self.n_draft_accepted += accepted
+            monitor.inc("decode/draft_tokens_total", k,
+                        replica=self.replica)
+            if accepted:
+                monitor.inc("decode/draft_accepted_total", accepted,
+                            replica=self.replica)
+            monitor.observe("decode/accept_rate", accepted / k,
+                            replica=self.replica)
+            for j in range(int(counts[i])):
+                if self._finished(req):
+                    # max_new / eos reached mid-run: the device wrote
+                    # the extra positions' K/V, but the sequence is
+                    # evicted below, so the surplus is unobservable —
+                    # emitted output stays byte-identical to the
+                    # non-speculative oracle
+                    break
+                self._emit_token(req, int(y[i, j]))
+        self._emit_cow_delta()
+        self._evict_finished()
+
+    def _emit_cow_delta(self) -> None:
+        cow = self.session.cow_copies + (self._draft.cow_copies
+                                         if self._draft is not None
+                                         else 0)
+        if cow > self._cow_seen:
+            monitor.inc("decode/cow_copies_total",
+                        cow - self._cow_seen, replica=self.replica)
+            self._cow_seen = cow
+
+    def disable_speculation(self) -> None:
+        """Drop the draft session (restart path when the draft export
+        cannot be reloaded): the replica keeps serving, plain decode —
+        an accelerator must never cost availability.  Scheduler-thread
+        only (like every cache mutation); active draft sequences are
+        released."""
+        if self._draft is None:
+            return
+        for _, _, dseq in self._active:
+            if dseq is not None:
+                self._draft.release(dseq)
+        self._active = [(r, s, None) for r, s, _ in self._active]
+        self._draft = None
+        # the monitor delta tracked target+draft COW as one sum;
+        # re-anchor on the target alone or the next (sum < seen)
+        # comparisons silently drop real target copies
+        self._cow_seen = self.session.cow_copies
 
     def _emit_token(self, req: _GenRequest, token: int) -> None:
         now = time.monotonic()
@@ -341,15 +520,17 @@ class ContinuousBatcher:
 
     def _evict_finished(self) -> None:
         keep = []
-        for req, seq in self._active:
+        for req, seq, dseq in self._active:
             if self._finished(req):
                 self.session.release(seq)
+                if dseq is not None and self._draft is not None:
+                    self._draft.release(dseq)
                 self.n_evicted += 1
                 monitor.inc("decode/evictions_total",
                             replica=self.replica)
                 req.done.set()
             else:
-                keep.append((req, seq))
+                keep.append((req, seq, dseq))
         self._active = keep
         monitor.set_gauge("decode/active_seqs", len(self._active),
                           replica=self.replica)
@@ -370,9 +551,11 @@ class ContinuousBatcher:
         path)."""
         self.n_step_errors += 1
         monitor.inc("decode/step_errors_total", replica=self.replica)
-        for _, seq in self._active:
+        for _, seq, dseq in self._active:
             self.session.release(seq)
-        failed, self._active = [r for r, _ in self._active], []
+            if dseq is not None and self._draft is not None:
+                self._draft.release(dseq)
+        failed, self._active = [r for r, _, _ in self._active], []
         self._fail_requests(list(extra or ()) + failed, err)
         monitor.set_gauge("decode/active_seqs", 0,
                           replica=self.replica)
@@ -402,8 +585,10 @@ class ContinuousBatcher:
         """Stop path: evict everything, fail what was still running."""
         err = Overloaded(
             f"decode replica {self.replica} is shutting down")
-        for req, seq in self._active:
+        for req, seq, dseq in self._active:
             self.session.release(seq)
+            if dseq is not None and self._draft is not None:
+                self._draft.release(dseq)
             self._fail_requests([req], err)
         self._active = []
         self._fail_pending(err)
@@ -422,8 +607,15 @@ class DecodeReplica:
                  max_restarts: int = 2, page_size: int = 16,
                  pages_per_seq: int = 8, max_seqs: int = 8,
                  prefill_buckets: tuple[int, ...] | None = None,
-                 donate: bool = True):
+                 donate: bool = True, draft_export_dir: str | None = None,
+                 prefix_cache: bool = True):
         from theanompi_tpu.decode.session import DecodeSession
+        from theanompi_tpu.serving.export import (
+            IncompatibleExport,
+            build_model_from_meta,
+            draft_incompatibility,
+            load_export,
+        )
 
         self.idx = int(idx)
         self.export_dir = export_dir
@@ -433,20 +625,64 @@ class DecodeReplica:
             model, params=loaded.params, version=loaded.version,
             page_size=page_size, pages_per_seq=pages_per_seq,
             max_seqs=max_seqs, prefill_buckets=prefill_buckets,
-            donate=donate)
+            donate=donate, prefix_cache=prefix_cache)
+        #: speculative decoding: a second (small) decode-capable
+        #: export proposes k tokens per round; same cache geometry so
+        #: a target admit implies draft capacity
+        self.draft_export_dir = draft_export_dir
+        self.draft_session = None
+        self.draft_meta = None
+        if draft_export_dir:
+            dloaded = load_export(draft_export_dir)
+            reason = draft_incompatibility(loaded.meta, dloaded.meta)
+            if reason is not None:
+                raise IncompatibleExport(
+                    f"draft export {draft_export_dir} "
+                    f"v{dloaded.version}: {reason}")
+            dmodel = build_model_from_meta(dloaded.meta)
+            self.draft_session = DecodeSession(
+                dmodel, params=dloaded.params, version=dloaded.version,
+                page_size=page_size, pages_per_seq=pages_per_seq,
+                max_seqs=max_seqs, prefill_buckets=prefill_buckets,
+                donate=donate, prefix_cache=prefix_cache)
+            self.draft_meta = dloaded.meta
         self.batcher = ContinuousBatcher(
             self.session, policy, replica=self.idx,
-            on_error=self._on_step_error)
+            on_error=self._on_step_error,
+            draft_session=self.draft_session)
 
     @property
     def alive(self) -> bool:
         return self.batcher.alive
+
+    def warmup(self) -> None:
+        """Compile the smallest program of every family this replica
+        can reach before the port binds."""
+        self.session.warmup()
+        if self.draft_session is not None:
+            k = int(self.batcher.policy.speculate_k)
+            self.session.warmup_spec(k, "target")
+            self.draft_session.warmup()
+            self.draft_session.warmup_spec(k, "draft")
 
     def generate(self, prompt, max_new: int | None = None) -> list[int]:
         return self.batcher.generate(prompt, max_new)
 
     def swap(self, version: int, params, model_state=None) -> None:
         self.session.swap(version, params, model_state)
+
+    def swap_draft(self, version: int, params) -> bool:
+        """Hot-swap draft weights (the reload watcher's draft poll);
+        monotonic like every session swap.  Draft K/V already cached
+        was computed by the old draft — still fine: draft caches only
+        bias PROPOSALS, and every proposal is verified by the target.
+        Returns False when this replica no longer speculates (a failed
+        draft restart downgraded it) so the watcher can report
+        honestly instead of logging a swap that reached nobody."""
+        if self.draft_session is None:
+            return False
+        self.draft_session.swap(version, params)
+        return True
 
     def _on_step_error(self, exc: BaseException) -> bool:
         from theanompi_tpu.serving.export import load_export
@@ -475,6 +711,22 @@ class DecodeReplica:
         # the failed step may have consumed the donated pool buffers —
         # restart on fresh pages (active sequences were already failed)
         self.session.reset_cache()
+        if self.draft_session is not None:
+            try:
+                dloaded = load_export(self.draft_export_dir,
+                                      version=self.draft_session.version)
+                self.draft_session.swap(dloaded.version, dloaded.params)
+                self.draft_session.reset_cache()
+            except Exception as e:
+                # the draft is an accelerator, not a dependency: a
+                # failed draft reload costs speculation, never the
+                # replica (runs on the scheduler thread, like every
+                # cache mutation)
+                print(f"[decode] replica {self.idx} draft restart "
+                      f"failed ({type(e).__name__}: {e}); speculation "
+                      "disabled, replica keeps serving", flush=True)
+                self.batcher.disable_speculation()
+                self.draft_session = None
         print(f"[decode] replica {self.idx} restarted from export "
               f"v{loaded.version} after {type(exc).__name__} "
               f"(restart {self.restarts}/{self.max_restarts})",

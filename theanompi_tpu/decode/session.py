@@ -18,6 +18,33 @@ keyed by the bucket discipline that keeps steady state recompile-free:
   token advances for every live sequence in a single device step over
   a fixed-shape page pool.
 
+Three more families serve the two token-throughput multipliers
+(docs/SERVING.md "Speculative decode" / "Prefix cache"), all riding
+the same bucket discipline so steady state stays recompile-free:
+
+* **verify** (target role) — one program per decode bucket at a fixed
+  ``k``: k drafted tokens + the pending one run as a (k+1)-token
+  chunk in ONE bucketed step; the accept count is computed INSIDE the
+  jit (longest matching prefix of target argmax vs drafts) and the
+  K/V scatter is count-masked, so rejected tokens are never written —
+  accept/reject is data, not shape, and never recompiles.
+* **propose** / **commit** (draft role) — ``propose`` runs k greedy
+  draft steps in one program, keeping the new K/V in SCRATCH outputs
+  (plus one extra pass for the k-th draft's K/V, so a full accept
+  leaves no cache gap); ``commit`` scatters the accepted prefix of
+  the scratch into the draft pool after the verdict.  The draft pool
+  therefore only ever holds accepted history — "rolling back past
+  rejected tokens" is simply not writing them.
+* **extend** — prefix-cache hit prefill: the prompt SUFFIX (padded to
+  a prefill bucket) attends the shared pages through the ring and
+  scatters only its own K/V into freshly allocated pages.
+
+Page sharing is host-side (refcounted ``PagePool`` + ``PrefixCache``,
+decode/kvcache.py) with copy-on-write: ``_cow_prepare`` runs before
+every writing program and replaces any still-shared page the write
+would touch with a private device copy (the fixed-shape ``cow_copy``
+program).
+
 Both donate the pool buffers (``donate_argnums``) — the cache updates
 in place, XLA never holds two pools.  Both count their own traces by a
 plain Python increment INSIDE the traced body (re-tracing re-runs the
@@ -49,6 +76,7 @@ import jax.numpy as jnp
 from theanompi_tpu.analysis.lockgraph import make_lock
 from theanompi_tpu.decode import kvcache
 from theanompi_tpu.decode.model import (
+    chunk_block,
     decode_block,
     embed_tokens,
     final_logits,
@@ -56,6 +84,10 @@ from theanompi_tpu.decode.model import (
 )
 from theanompi_tpu.serving.batcher import default_buckets, pick_bucket
 from theanompi_tpu.serving.export import dequantize_tree
+
+#: pairs per COW copy program call (fixed shape — one compile ever);
+#: bursts larger than this just loop the same program
+COPY_BUCKET = 8
 
 
 def default_prefill_buckets(max_len: int,
@@ -88,7 +120,7 @@ class DecodeSession:
                  page_size: int = 16, pages_per_seq: int = 8,
                  max_seqs: int = 8,
                  prefill_buckets: tuple[int, ...] | None = None,
-                 donate: bool = True):
+                 donate: bool = True, prefix_cache: bool = True):
         module = model.module
         for field in ("n_layers", "n_heads", "d_model", "max_len"):
             if not hasattr(module, field):
@@ -129,14 +161,35 @@ class DecodeSession:
         # scheduler-thread-owned device + host cache state
         self._ck, self._cv = kvcache.init_pages(self.cfg)
         self.pool = kvcache.PagePool(self.cfg)
+        #: cross-request prefix cache (None = sharing disabled)
+        self.prefix_cache = (kvcache.PrefixCache(self.pool, self.window)
+                             if prefix_cache else None)
+        #: device page copies made to un-share a page before a write
+        self.cow_copies = 0
+        #: draft role: (scratch_k, scratch_v, bucket, n) pending commit
+        self._scratch = None
 
         #: traces per program family — incremented at TRACE time inside
         #: the jitted bodies; the steady-state-zero-recompiles pin
-        self.compiles = {"prefill": 0, "decode": 0}
+        self.compiles = {"prefill": 0, "decode": 0, "verify": 0,
+                         "propose": 0, "commit": 0, "extend": 0,
+                         "cow_copy": 0}
         self._prefill = jax.jit(
             self._prefill_fn, donate_argnums=(1, 2) if donate else ())
         self._decode = jax.jit(
             self._decode_fn, donate_argnums=(1, 2) if donate else ())
+        self._verify = jax.jit(
+            self._verify_fn, donate_argnums=(1, 2) if donate else ())
+        # propose READS the pool (no writes) — nothing donated, the
+        # live pool buffers must survive the call for verify/commit
+        self._propose = jax.jit(self._propose_fn,
+                                static_argnames=("k",))
+        self._commit = jax.jit(
+            self._commit_fn, donate_argnums=(0, 1) if donate else ())
+        self._extend = jax.jit(
+            self._extend_fn, donate_argnums=(1, 2) if donate else ())
+        self._copy = jax.jit(
+            self._copy_fn, donate_argnums=(0, 1) if donate else ())
 
     # -- params ---------------------------------------------------------
 
@@ -206,36 +259,264 @@ class DecodeSession:
                                           active, jnp.stack(v_new))
         return k_pages, v_pages, final_logits(p, x, self.dtype)[:, 0]
 
+    def _verify_fn(self, params, k_pages, v_pages, tokens, lengths,
+                   page_rows, active):
+        """Target role: tokens (S, k+1) = [pending, d_1..d_k] run as
+        one chunk; accept count and the count-masked K/V writes happen
+        in-jit, so accept/reject boundaries are data, never shapes."""
+        self.compiles["verify"] += 1       # trace-time counter
+        p = dequantize_tree(params)
+        c = tokens.shape[1]
+        pos = jnp.minimum(
+            lengths[:, None] + jnp.arange(c, dtype=jnp.int32),
+            self.max_len - 1)
+        x = embed_tokens(p, tokens, pos).astype(self.dtype)
+        ring_mask = kvcache.chunk_cache_mask(lengths, c, self.window)
+        k_new, v_new = [], []
+        for layer in range(self.n_layers):
+            kc = kvcache.gather_layer(k_pages[layer], page_rows)
+            vc = kvcache.gather_layer(v_pages[layer], page_rows)
+            x, kn, vn = chunk_block(p[f"Block_{layer}"], x, kc, vc,
+                                    ring_mask, self.n_heads,
+                                    self.dtype, window=self.window)
+            k_new.append(kn)
+            v_new.append(vn)
+        y = jnp.argmax(final_logits(p, x, self.dtype),
+                       axis=-1).astype(jnp.int32)          # (S, k+1)
+        # longest matching prefix: d_i accepted iff it equals the
+        # target's own argmax y_{i-1} and every earlier draft matched
+        match = (tokens[:, 1:] == y[:, :-1]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        counts = jnp.where(active, m + 1, 0).astype(jnp.int32)
+        k_pages = kvcache.write_tokens_all(k_pages, page_rows, lengths,
+                                           counts, jnp.stack(k_new))
+        v_pages = kvcache.write_tokens_all(v_pages, page_rows, lengths,
+                                           counts, jnp.stack(v_new))
+        return k_pages, v_pages, y, counts
+
+    def _propose_fn(self, params, k_pages, v_pages, tokens, lengths,
+                    page_rows, active, *, k):
+        """Draft role: k greedy one-token steps unrolled in ONE
+        program, new K/V accumulated in scratch (token i attends the
+        ring + scratch tokens 0..i-1 + itself) and RETURNED, never
+        written — plus one extra K/V-only pass for the k-th draft, so
+        a full accept leaves the draft cache gap-free.  Padding rows
+        produce garbage the commit's zero count drops."""
+        self.compiles["propose"] += 1      # trace-time counter
+        del active
+        p = dequantize_tree(params)
+        s_ = tokens.shape[0]
+        ring_mask = kvcache.chunk_cache_mask(lengths, k + 1, self.window)
+        rk = [kvcache.gather_layer(k_pages[layer], page_rows)
+              for layer in range(self.n_layers)]
+        rv = [kvcache.gather_layer(v_pages[layer], page_rows)
+              for layer in range(self.n_layers)]
+        scratch_k = [[] for _ in range(self.n_layers)]
+        scratch_v = [[] for _ in range(self.n_layers)]
+        drafts = []
+        tok = tokens
+        for i in range(k + 1):
+            pos = jnp.minimum(lengths + i, self.max_len - 1)
+            x = embed_tokens(p, tok, pos)[:, None, :].astype(self.dtype)
+            for layer in range(self.n_layers):
+                if i:
+                    kc = jnp.concatenate(
+                        [rk[layer], jnp.stack(scratch_k[layer], 1)], 1)
+                    vc = jnp.concatenate(
+                        [rv[layer], jnp.stack(scratch_v[layer], 1)], 1)
+                    m = jnp.concatenate(
+                        [ring_mask[:, i], jnp.ones((s_, i), bool)], 1)
+                else:
+                    kc, vc, m = rk[layer], rv[layer], ring_mask[:, 0]
+                x, kn, vn = decode_block(p[f"Block_{layer}"], x, kc,
+                                         vc, m, self.n_heads,
+                                         self.dtype)
+                scratch_k[layer].append(kn)
+                scratch_v[layer].append(vn)
+            if i < k:
+                tok = jnp.argmax(final_logits(p, x, self.dtype)[:, 0],
+                                 axis=-1).astype(jnp.int32)
+                drafts.append(tok)
+        return (jnp.stack(drafts, 1),                     # (S, k)
+                jnp.stack([jnp.stack(s, 1) for s in scratch_k]),
+                jnp.stack([jnp.stack(s, 1) for s in scratch_v]))
+
+    def _commit_fn(self, k_pages, v_pages, scratch_k, scratch_v,
+                   lengths, page_rows, counts):
+        """Draft role: scatter the verdict's accepted prefix of the
+        propose scratch into the pool (count-masked, like verify)."""
+        self.compiles["commit"] += 1       # trace-time counter
+        k_pages = kvcache.write_tokens_all(k_pages, page_rows, lengths,
+                                           counts, scratch_k)
+        v_pages = kvcache.write_tokens_all(v_pages, page_rows, lengths,
+                                           counts, scratch_v)
+        return k_pages, v_pages
+
+    def _extend_fn(self, params, k_pages, v_pages, tokens, start,
+                   length, page_row):
+        """Prefix-cache hit prefill: the prompt SUFFIX (one sequence,
+        padded to a prefill bucket) attends the shared prefix through
+        the ring and scatters only its own positions' K/V — into the
+        freshly allocated suffix pages, never the shared ones."""
+        self.compiles["extend"] += 1       # trace-time counter
+        p = dequantize_tree(params)
+        c = tokens.shape[1]
+        starts = jnp.reshape(start, (1,)).astype(jnp.int32)
+        pos = jnp.minimum(
+            starts[:, None] + jnp.arange(c, dtype=jnp.int32),
+            self.max_len - 1)
+        x = embed_tokens(p, tokens, pos).astype(self.dtype)
+        ring_mask = kvcache.chunk_cache_mask(starts, c, self.window)
+        rows = page_row[None]
+        k_new, v_new = [], []
+        for layer in range(self.n_layers):
+            kc = kvcache.gather_layer(k_pages[layer], rows)
+            vc = kvcache.gather_layer(v_pages[layer], rows)
+            x, kn, vn = chunk_block(p[f"Block_{layer}"], x, kc, vc,
+                                    ring_mask, self.n_heads,
+                                    self.dtype, window=self.window)
+            k_new.append(kn)
+            v_new.append(vn)
+        logits = final_logits(p, x, self.dtype)
+        counts = jnp.reshape(length, (1,)).astype(jnp.int32)
+        k_pages = kvcache.write_tokens_all(k_pages, rows, starts,
+                                           counts, jnp.stack(k_new))
+        v_pages = kvcache.write_tokens_all(v_pages, rows, starts,
+                                           counts, jnp.stack(v_new))
+        return k_pages, v_pages, logits[0, length - 1]
+
+    def _copy_fn(self, k_pages, v_pages, src, dst):
+        """Copy-on-write: duplicate pages ``src[i] -> dst[i]`` in both
+        pools (fixed COPY_BUCKET pairs; padding writes to the dropped
+        page id)."""
+        self.compiles["cow_copy"] += 1     # trace-time counter
+        k_pages = k_pages.at[:, dst].set(k_pages[:, src], mode="drop")
+        v_pages = v_pages.at[:, dst].set(v_pages[:, src], mode="drop")
+        return k_pages, v_pages
+
     # -- scheduler-facing host API (single scheduler thread) ------------
 
     def can_admit(self) -> bool:
-        return self.pool.free_pages >= self.cfg.pages_per_seq
+        free = self.pool.free_pages
+        if self.prefix_cache is not None:
+            # LRU eviction under allocation pressure frees cache-only
+            # pages (_alloc_pages), so they count as admissible
+            free += self.prefix_cache.evictable_pages()
+        return free >= self.cfg.pages_per_seq
+
+    def _alloc_pages(self, n: int) -> list[int] | None:
+        """Allocate with eviction pressure: a full pool evicts prefix-
+        cache LRU entries until the allocation fits or the cache is
+        dry (the ring/free-list discipline extended to shared pages)."""
+        while True:
+            got = self.pool.alloc(n)
+            if got is not None:
+                return got
+            if self.prefix_cache is None or not len(self.prefix_cache):
+                return None
+            self.prefix_cache.evict_lru()
+
+    def _cow_prepare(self, seqs: list[_Seq], span: int) -> None:
+        """Copy-on-write fence before a program that writes positions
+        ``[length, length+span)``: every touched page still shared
+        (refcount > 1) is swapped for a private device copy first, so
+        a write can never reach a page another sequence or the prefix
+        cache still reads.  Evicting cache entries for the copy's page
+        may drop the LAST other reference — then no copy is needed at
+        all (the page just became private)."""
+        ps = self.cfg.page_size
+        src, dst = [], []
+        for s in seqs:
+            touched = sorted({(p % self.window) // ps
+                              for p in range(s.length, s.length + span)})
+            for idx in touched:
+                pid = int(s.page_row[idx])
+                while self.pool.refcount(pid) > 1:
+                    got = self.pool.alloc(1)
+                    if got is None:
+                        if (self.prefix_cache is not None
+                                and len(self.prefix_cache)):
+                            self.prefix_cache.evict_lru()
+                            continue
+                        raise RuntimeError(
+                            "page pool exhausted during copy-on-write")
+                    src.append(pid)
+                    dst.append(got[0])
+                    self.pool.decref([pid])
+                    s.page_row[idx] = got[0]
+                    self.cow_copies += 1
+                    break
+        for i in range(0, len(src), COPY_BUCKET):
+            sb = np.zeros(COPY_BUCKET, np.int32)
+            db = np.full(COPY_BUCKET, self.cfg.n_pages, np.int32)
+            chunk = src[i:i + COPY_BUCKET]
+            sb[:len(chunk)] = chunk
+            db[:len(chunk)] = dst[i:i + COPY_BUCKET]
+            self._ck, self._cv = self._copy(
+                self._ck, self._cv, jnp.asarray(sb), jnp.asarray(db))
 
     def admit(self, prompt: np.ndarray) -> tuple[_Seq, np.ndarray]:
         """Allocate pages, prefill the prompt, return the new sequence
-        and the last real token's f32 logits (V,)."""
+        and the last real token's f32 logits (V,).
+
+        With the prefix cache on, a prompt starting with a cached
+        page-aligned prefix ALIASES the shared pages (refcount++) and
+        prefills only the suffix (the ``extend`` program); either way
+        the prompt's own page-aligned prefixes are registered for the
+        next stream."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t = prompt.shape[0]
         if not 1 <= t <= self.max_prompt:
             raise ValueError(
                 f"prompt length {t} outside [1, {self.max_prompt}] "
                 "(largest prefill bucket)")
-        page_row = self.pool.alloc_seq()
-        if page_row is None:
-            raise RuntimeError("admit() without free pages — the "
-                               "scheduler must check can_admit() first")
-        bucket = pick_bucket(t, self.prefill_buckets)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :t] = prompt
         _, params = self._live          # one-read snapshot
-        try:
-            self._ck, self._cv, logits = self._prefill(
-                params, self._ck, self._cv, jnp.asarray(tokens),
-                jnp.int32(t), jnp.asarray(page_row))
-        except Exception:
-            # a failed prefill must not leak the sequence's pages
-            self.pool.free_seq(page_row)
-            raise
+        hit = (self.prefix_cache.lookup(prompt)
+               if self.prefix_cache is not None else None)
+        if hit is not None:
+            # adopt the shared pages BEFORE any allocation that could
+            # evict the entry (and free them) out from under us
+            self.pool.incref(hit.pages)
+            fresh = self._alloc_pages(
+                self.cfg.pages_per_seq - len(hit.pages))
+            if fresh is None:
+                self.pool.decref(hit.pages)
+                raise RuntimeError(
+                    "admit() without free pages — the scheduler must "
+                    "check can_admit() first")
+            page_row = np.asarray(list(hit.pages) + fresh, np.int32)
+            start, suffix = hit.n_tokens, t - hit.n_tokens
+            bucket = pick_bucket(suffix, self.prefill_buckets)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :suffix] = prompt[start:]
+            try:
+                self._ck, self._cv, logits = self._extend(
+                    params, self._ck, self._cv, jnp.asarray(tokens),
+                    jnp.int32(start), jnp.int32(suffix),
+                    jnp.asarray(page_row))
+            except Exception:
+                self.pool.decref(page_row)
+                raise
+        else:
+            got = self._alloc_pages(self.cfg.pages_per_seq)
+            if got is None:
+                raise RuntimeError(
+                    "admit() without free pages — the scheduler must "
+                    "check can_admit() first")
+            page_row = np.asarray(got, np.int32)
+            bucket = pick_bucket(t, self.prefill_buckets)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :t] = prompt
+            try:
+                self._ck, self._cv, logits = self._prefill(
+                    params, self._ck, self._cv, jnp.asarray(tokens),
+                    jnp.int32(t), jnp.asarray(page_row))
+            except Exception:
+                # a failed prefill must not leak the sequence's pages
+                self.pool.free_seq(page_row)
+                raise
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(prompt, page_row)
         return _Seq(page_row, t), np.asarray(jax.device_get(logits))
 
     def decode(self, seqs: list[_Seq],
@@ -248,6 +529,7 @@ class DecodeSession:
         if not 1 <= n <= self.cfg.max_seqs:
             raise ValueError(f"{n} sequences outside "
                              f"[1, {self.cfg.max_seqs}]")
+        self._cow_prepare(seqs, 1)
         bucket = pick_bucket(n, self.decode_buckets)
         toks = np.zeros((bucket,), np.int32)
         lens = np.zeros((bucket,), np.int32)
@@ -267,6 +549,107 @@ class DecodeSession:
             s.length += 1
         return np.asarray(jax.device_get(logits))[:n]
 
+    def verify(self, seqs: list[_Seq], pending: np.ndarray,
+               drafts: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Target role: check each sequence's k drafted tokens in ONE
+        bucketed step.  ``pending``: (n,) the last emitted token per
+        sequence (decode would feed the same); ``drafts``: (n, k).
+        Returns (y (n, k+1) — the target's own greedy token at every
+        chunk position — and counts (n,) = accepted drafts + 1).  The
+        caller emits ``y[i, :counts[i]]``; each sequence's length
+        advances by its count (the K/V of exactly those tokens were
+        written)."""
+        n = len(seqs)
+        drafts = np.asarray(drafts, np.int32).reshape(n, -1)
+        k1 = drafts.shape[1] + 1
+        if not 1 <= n <= self.cfg.max_seqs:
+            raise ValueError(f"{n} sequences outside "
+                             f"[1, {self.cfg.max_seqs}]")
+        if k1 > self.window:
+            raise ValueError(f"speculation chunk {k1} exceeds the "
+                             f"ring window {self.window}")
+        self._cow_prepare(seqs, k1)
+        bucket = pick_bucket(n, self.decode_buckets)
+        toks = np.zeros((bucket, k1), np.int32)
+        lens = np.zeros((bucket,), np.int32)
+        rows = np.full((bucket, self.cfg.pages_per_seq),
+                       self.cfg.n_pages, np.int32)
+        active = np.zeros((bucket,), bool)
+        for i, s in enumerate(seqs):
+            toks[i, 0] = pending[i]
+            toks[i, 1:] = drafts[i]
+            lens[i] = s.length
+            rows[i] = s.page_row
+            active[i] = True
+        _, params = self._live          # one-read snapshot
+        self._ck, self._cv, y, counts = self._verify(
+            params, self._ck, self._cv, jnp.asarray(toks),
+            jnp.asarray(lens), jnp.asarray(rows), jnp.asarray(active))
+        y = np.asarray(jax.device_get(y))[:n]
+        counts = np.asarray(jax.device_get(counts))[:n]
+        for i, s in enumerate(seqs):
+            s.length += int(counts[i])
+        return y, counts
+
+    def propose(self, seqs: list[_Seq], pending: np.ndarray,
+                k: int) -> np.ndarray:
+        """Draft role: k greedy proposals per sequence in one program
+        call; the proposals' K/V stays in scratch (held on the session
+        until :meth:`commit`) — the pool is untouched, so rejected
+        drafts never need a ring rollback.  Returns drafts (n, k)."""
+        n = len(seqs)
+        if not 1 <= n <= self.cfg.max_seqs:
+            raise ValueError(f"{n} sequences outside "
+                             f"[1, {self.cfg.max_seqs}]")
+        if not 1 <= int(k) <= self.window - 1:
+            raise ValueError(f"speculate_k {k} outside "
+                             f"[1, window-1={self.window - 1}]")
+        bucket = pick_bucket(n, self.decode_buckets)
+        toks = np.zeros((bucket,), np.int32)
+        lens = np.zeros((bucket,), np.int32)
+        rows = np.full((bucket, self.cfg.pages_per_seq),
+                       self.cfg.n_pages, np.int32)
+        active = np.zeros((bucket,), bool)
+        for i, s in enumerate(seqs):
+            toks[i] = pending[i]
+            lens[i] = s.length
+            rows[i] = s.page_row
+            active[i] = True
+        _, params = self._live          # one-read snapshot
+        drafts, sk, sv = self._propose(
+            params, self._ck, self._cv, jnp.asarray(toks),
+            jnp.asarray(lens), jnp.asarray(rows), jnp.asarray(active),
+            k=int(k))
+        self._scratch = (sk, sv, bucket, n)
+        return np.asarray(jax.device_get(drafts))[:n]
+
+    def commit(self, seqs: list[_Seq], counts: np.ndarray) -> None:
+        """Draft role: write the accepted prefix of the last
+        :meth:`propose` scratch into the pool and advance lengths —
+        the draft cache only ever holds accepted history."""
+        if self._scratch is None:
+            raise RuntimeError("commit() without a pending propose()")
+        sk, sv, bucket, n = self._scratch
+        self._scratch = None
+        if len(seqs) != n:
+            raise ValueError(
+                f"commit over {len(seqs)} sequences but propose ran "
+                f"over {n}")
+        self._cow_prepare(seqs, int(sk.shape[2]))
+        cnt = np.zeros((bucket,), np.int32)
+        lens = np.zeros((bucket,), np.int32)
+        rows = np.full((bucket, self.cfg.pages_per_seq),
+                       self.cfg.n_pages, np.int32)
+        for i, s in enumerate(seqs):
+            cnt[i] = counts[i]
+            lens[i] = s.length
+            rows[i] = s.page_row
+        self._ck, self._cv = self._commit(
+            self._ck, self._cv, sk, sv, jnp.asarray(lens),
+            jnp.asarray(rows), jnp.asarray(cnt))
+        for i, s in enumerate(seqs):
+            s.length += int(counts[i])
+
     def release(self, seq: _Seq) -> None:
         self.pool.free_seq(seq.page_row)
 
@@ -277,11 +660,17 @@ class DecodeSession:
         already failed and released by the scheduler."""
         self._ck, self._cv = kvcache.init_pages(self.cfg)
         self.pool = kvcache.PagePool(self.cfg)
+        if self.prefix_cache is not None:
+            self.prefix_cache = kvcache.PrefixCache(self.pool,
+                                                    self.window)
+        self._scratch = None
 
     def warmup(self) -> None:
         """Compile the smallest prefill and decode programs before the
         port binds (the rest compile once at first use — still 'once
-        ever' per bucket, which is what the counter pins)."""
+        ever' per bucket, which is what the counter pins).  With the
+        prefix cache on, the smallest extend program and the COW copy
+        program warm too."""
         _, params = self._live
         drop_row = np.full((self.cfg.pages_per_seq,), self.cfg.n_pages,
                            np.int32)
@@ -297,3 +686,37 @@ class DecodeSession:
             jnp.zeros((bucket,), jnp.int32),
             jnp.zeros((bucket,), jnp.int32), jnp.asarray(rows),
             jnp.zeros((bucket,), bool))
+        if self.prefix_cache is not None:
+            self._ck, self._cv, _ = self._extend(
+                params, self._ck, self._cv, jnp.asarray(tokens),
+                jnp.int32(0), jnp.int32(1), jnp.asarray(drop_row))
+            self._ck, self._cv = self._copy(
+                self._ck, self._cv,
+                jnp.zeros((COPY_BUCKET,), jnp.int32),
+                jnp.full((COPY_BUCKET,), self.cfg.n_pages, jnp.int32))
+
+    def warmup_spec(self, k: int, role: str) -> None:
+        """Compile the speculative programs for the smallest decode
+        bucket before the port binds: ``'target'`` warms verify,
+        ``'draft'`` warms propose + commit."""
+        _, params = self._live
+        bucket = self.decode_buckets[0]
+        rows = np.full((bucket, self.cfg.pages_per_seq),
+                       self.cfg.n_pages, np.int32)
+        lens = jnp.zeros((bucket,), jnp.int32)
+        if role == "target":
+            self._ck, self._cv, _, _ = self._verify(
+                params, self._ck, self._cv,
+                jnp.zeros((bucket, int(k) + 1), jnp.int32), lens,
+                jnp.asarray(rows), jnp.zeros((bucket,), bool))
+        elif role == "draft":
+            _, sk, sv = self._propose(
+                params, self._ck, self._cv,
+                jnp.zeros((bucket,), jnp.int32), lens,
+                jnp.asarray(rows), jnp.zeros((bucket,), bool),
+                k=int(k))
+            self._ck, self._cv = self._commit(
+                self._ck, self._cv, sk, sv, lens, jnp.asarray(rows),
+                jnp.zeros((bucket,), jnp.int32))
+        else:
+            raise ValueError(f"unknown warmup role {role!r}")

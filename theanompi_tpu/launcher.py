@@ -226,6 +226,21 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                    help="SERVE --decode: padded prompt-length buckets "
                         "(default powers of two up to min(512, "
                         "max_len))")
+    p.add_argument("--decode-draft-export-dir", default=None,
+                   metavar="DIR",
+                   help="SERVE --decode: speculative decoding — a "
+                        "small decode-capable export proposing tokens "
+                        "the target verifies k-at-a-time in one "
+                        "bucketed step (docs/SERVING.md 'Speculative "
+                        "decode'); dims may differ, vocab must match")
+    p.add_argument("--decode-speculate-k", type=int, default=4,
+                   help="SERVE --decode: draft tokens per speculative "
+                        "round (needs --decode-draft-export-dir)")
+    p.add_argument("--decode-no-prefix-cache", action="store_true",
+                   help="SERVE --decode: disable the cross-request "
+                        "prefix cache (copy-on-write KV page sharing "
+                        "is on by default — docs/SERVING.md 'Prefix "
+                        "cache')")
     p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
                    help="persistent XLA compilation cache "
                         "(utils/helper_funcs.enable_compilation_cache): "

@@ -32,6 +32,7 @@ from theanompi_tpu.serving.export import (
     LoadedExport,
     build_model_from_meta,
     dequantize_tree,
+    draft_incompatibility,
     export_incompatibility,
     export_model,
     latest_export_version,
@@ -51,7 +52,8 @@ __all__ = [
     "BatchPolicy", "DynamicBatcher", "Overloaded", "default_buckets",
     "pick_bucket", "IncompatibleExport", "InferenceSession",
     "LoadedExport", "build_model_from_meta", "dequantize_tree",
-    "export_incompatibility", "export_model", "latest_export_version",
+    "draft_incompatibility", "export_incompatibility", "export_model",
+    "latest_export_version",
     "load_export", "quantize_tree", "DEFAULT_PORT", "InferenceClient",
     "InferenceServer", "Replica", "serve", "serve_main",
 ]

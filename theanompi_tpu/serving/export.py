@@ -169,6 +169,40 @@ def export_incompatibility(live_meta: dict, new_meta: dict) -> str | None:
     return None
 
 
+def draft_incompatibility(target_meta: dict,
+                          draft_meta: dict) -> str | None:
+    """Why a draft export must NOT speculate for a live target — None
+    when compatible.  The draft's DIMS are free (a smaller net is the
+    whole point); what must agree is the token space and the
+    positional range, because the target verifies draft TOKENS, not
+    draft activations:
+
+    * ``decode`` capability — the draft runs the same decode plane;
+    * ``vocab`` — a draft emitting ids the target never trained on
+      (or missing ids it would propose) breaks the accept comparison;
+    * the positional table must cover the target's — a draft that
+      clamps positions earlier than the target silently degrades
+      accept rate deep into long streams, so it is refused loudly.
+
+    Enforced at replica construction AND by the reload watcher's
+    draft poll (typed :class:`IncompatibleExport`, remembered like
+    every refused publish — server keeps serving)."""
+    if not draft_meta.get("decode"):
+        return "draft export is not decode-capable"
+    t_net = target_meta.get("net") or {}
+    d_net = draft_meta.get("net") or {}
+    if t_net.get("vocab") != d_net.get("vocab"):
+        return (f"draft vocab {d_net.get('vocab')} != target vocab "
+                f"{t_net.get('vocab')}")
+    # TransformerLM's positional table: max(2048, seq_len)
+    t_max = max(2048, int(t_net.get("seq_len") or 0))
+    d_max = max(2048, int(d_net.get("seq_len") or 0))
+    if d_max < t_max:
+        return (f"draft positional table {d_max} shorter than the "
+                f"target's {t_max}")
+    return None
+
+
 def _host(tree: PyTree) -> PyTree:
     return jax.tree.map(np.asarray, jax.device_get(tree))
 

@@ -55,6 +55,7 @@ from theanompi_tpu.serving.export import (
     IncompatibleExport,
     InferenceSession,
     build_model_from_meta,
+    draft_incompatibility,
     export_incompatibility,
     latest_export_version,
     load_export,
@@ -164,6 +165,9 @@ class InferenceServer:
         #: meta of the version being served — the hot-reload
         #: compatibility anchor (export_incompatibility)
         self._meta = loaded.meta             # guarded_by: self._reload_lock
+        self.draft_export_dir = None
+        self.draft_version = None            # guarded_by: self._reload_lock
+        self._draft_meta = None              # guarded_by: self._reload_lock
         if self.decode:
             # autoregressive mode (theanompi_tpu/decode): replicas are
             # DecodeReplicas (paged KV-cache + continuous batcher) and
@@ -178,7 +182,8 @@ class InferenceServer:
             opts = dict(decode_opts or {})
             pol_kw = {k: opts.pop(k)
                       for k in ("max_pending", "max_new_cap",
-                                "submit_timeout_s", "eos_token")
+                                "submit_timeout_s", "eos_token",
+                                "speculate_k")
                       if k in opts}
             self.replicas = [
                 DecodeReplica(i, self.export_dir, self.model, loaded,
@@ -187,9 +192,20 @@ class InferenceServer:
                               **opts)
                 for i in range(int(replicas))
             ]
+            #: draft-export watcher state (speculative decoding): the
+            #: replicas validated + loaded the draft at construction;
+            #: the watcher polls its dir like the target's
+            self.draft_export_dir = (
+                os.path.abspath(opts["draft_export_dir"])
+                if opts.get("draft_export_dir") else None)
+            r0 = self.replicas[0]
+            self.draft_version = (            # guarded_by: self._reload_lock
+                r0.draft_session.version
+                if r0.draft_session is not None else None)
+            self._draft_meta = r0.draft_meta  # guarded_by: self._reload_lock
             if warmup:
                 for r in self.replicas:
-                    r.session.warmup()
+                    r.warmup()
         else:
             self.replicas = [
                 Replica(i, self.export_dir, self.policy, loaded,
@@ -224,6 +240,12 @@ class InferenceServer:
         #: typed error regardless of whether the background watcher
         #: observed the publish first
         self._bad_reason: str | None = None  # guarded_by: self._reload_lock
+        #: same memory for the DRAFT export's poll (speculative
+        #: decoding): a published draft whose dims/vocab are
+        #: incompatible with the live target is refused once, loudly,
+        #: and remembered until a strictly newer draft publish
+        self._bad_draft_newest: int | None = None  # guarded_by: self._reload_lock
+        self._bad_draft_reason: str | None = None  # guarded_by: self._reload_lock
         monitor.set_gauge("serving/model_version", self.version)
         monitor.set_gauge("serving/replicas", len(self.replicas))
 
@@ -350,20 +372,90 @@ class InferenceServer:
                   "requests kept)", flush=True)
             return self.version
 
+    def check_draft_reload(self) -> int | None:
+        """One poll of the DRAFT export dir (speculative decoding):
+        load + swap a newer compatible draft into every replica;
+        returns the serving draft version (None when speculation is
+        off).  A draft whose dims/vocab no longer fit the live target
+        raises the typed :class:`IncompatibleExport` — refused and
+        REMEMBERED exactly like a refused target publish (no re-load
+        churn, every reload re-raises from memory, the server keeps
+        serving and keeps speculating on the old draft) until a
+        strictly newer draft version supersedes it."""
+        if not self.decode or self.draft_export_dir is None:
+            return None
+        with self._reload_lock:
+            newest = latest_export_version(self.draft_export_dir)
+            if newest is None or newest <= self.draft_version:
+                return self.draft_version
+            if newest == self._bad_draft_newest:
+                if self._bad_draft_reason is not None:
+                    raise IncompatibleExport(self._bad_draft_reason)
+                return self.draft_version
+            loaded = load_export(self.draft_export_dir)
+            if loaded.version <= self.draft_version:
+                # newest manifest failed verification; fell back —
+                # remember like the target poll does
+                self._bad_draft_newest = newest
+                self._bad_draft_reason = None
+                return self.draft_version
+            # two anchors: the live TARGET (vocab/positional range —
+            # the accept comparison) and the live DRAFT session (net
+            # dims etc. — the new arrays must adopt into the compiled
+            # draft programs, the same reason target hot reload
+            # refuses a resized net; restart to change draft dims)
+            reason = (draft_incompatibility(self._meta, loaded.meta)
+                      or export_incompatibility(self._draft_meta,
+                                                loaded.meta))
+            if reason is not None:
+                self._bad_draft_newest = newest
+                self._bad_draft_reason = (
+                    f"refusing draft hot reload v{self.draft_version} "
+                    f"-> v{loaded.version}: {reason}")
+                monitor.inc("serving/reload_refused_total")
+                print(f"[serving] {self._bad_draft_reason}", flush=True)
+                raise IncompatibleExport(self._bad_draft_reason)
+            self._bad_draft_newest = None
+            self._bad_draft_reason = None
+            swapped = sum(1 for r in self.replicas
+                          if r.swap_draft(loaded.version,
+                                          loaded.params))
+            if swapped == 0:
+                # every replica downgraded to plain decode (failed
+                # draft restarts): there is no draft session to swap
+                # into, and claiming a reload would advertise a draft
+                # version nobody serves — restart to re-enable
+                print(f"[serving] draft v{loaded.version} published "
+                      "but speculation is disabled on every replica "
+                      "(failed draft restarts); not swapped — restart "
+                      "the server to re-enable speculation",
+                      flush=True)
+                return self.draft_version
+            self._draft_meta = loaded.meta
+            old, self.draft_version = self.draft_version, loaded.version
+            monitor.inc("serving/reloads_total")
+            print(f"[serving] draft hot reload v{old} -> "
+                  f"v{self.draft_version} ({swapped}/"
+                  f"{len(self.replicas)} replicas speculating, "
+                  "in-flight streams kept)", flush=True)
+            return self.draft_version
+
     def _watch_reload(self) -> None:
         while not self._stop.wait(self.reload_poll_s):
-            try:
-                self.check_reload()
-            except IncompatibleExport:
-                # already printed once at refusal time; the remembered
-                # refusal re-raises every poll until superseded, and
-                # re-printing it each second is pure log spam
-                pass
-            except Exception as e:
-                # a broken half-published export must not kill the
-                # watcher; next poll retries
-                print(f"[serving] reload check failed: "
-                      f"{type(e).__name__}: {e}", flush=True)
+            for check in (self.check_reload, self.check_draft_reload):
+                try:
+                    check()
+                except IncompatibleExport:
+                    # already printed once at refusal time; the
+                    # remembered refusal re-raises every poll until
+                    # superseded, and re-printing it each second is
+                    # pure log spam
+                    pass
+                except Exception as e:
+                    # a broken half-published export must not kill the
+                    # watcher; next poll retries
+                    print(f"[serving] reload check failed: "
+                          f"{type(e).__name__}: {e}", flush=True)
 
     # -- introspection -------------------------------------------------
 
@@ -379,6 +471,7 @@ class InferenceServer:
                          version=r.session.version)
                     for r in self.replicas]
             version = self.version
+            draft_version = self.draft_version
         out = {
             "version": version,
             "decode": self.decode,
@@ -388,6 +481,11 @@ class InferenceServer:
         }
         if self.decode:
             # decode replicas account tokens/steps, not batches/rows
+            drafted = sum((r.get("speculation") or {})
+                          .get("draft_tokens", 0) for r in reps)
+            accepted = sum((r.get("speculation") or {})
+                           .get("accepted_draft_tokens", 0)
+                           for r in reps)
             out.update(
                 tokens=sum(r.get("tokens", 0) for r in reps),
                 steps=sum(r.get("steps", 0) for r in reps),
@@ -395,6 +493,13 @@ class InferenceServer:
                                  for r in reps),
                 max_concurrent=max((r.get("max_concurrent", 0)
                                     for r in reps), default=0),
+                draft_version=draft_version,
+                draft_tokens=drafted,
+                accepted_draft_tokens=accepted,
+                accept_rate=accepted / drafted if drafted else None,
+                prefix_cache_hits=sum(
+                    (r.get("prefix_cache") or {}).get("hits", 0)
+                    for r in reps),
             )
         else:
             out.update(
@@ -434,7 +539,13 @@ class InferenceServer:
         if op == "stats":
             return self.stats()
         if op == "reload":
-            return self.check_reload()
+            # target first, then the draft poll — either refusal
+            # surfaces as the typed IncompatibleExport (a successful
+            # target swap is already committed when a draft refusal
+            # raises; the next reload returns the new version)
+            version = self.check_reload()
+            self.check_draft_reload()
+            return version
         if op == "ping":
             return "pong"
         raise ValueError(f"unknown op {op!r}")
@@ -575,10 +686,14 @@ def decode_opts_from_args(args) -> dict | None:
         "pages_per_seq": args.decode_pages_per_seq,
         "max_seqs": args.decode_max_seqs,
         "max_pending": args.decode_max_pending,
+        "prefix_cache": not args.decode_no_prefix_cache,
     }
     if args.decode_prefill_buckets:
         opts["prefill_buckets"] = tuple(
             int(b) for b in args.decode_prefill_buckets.split(","))
+    if args.decode_draft_export_dir:
+        opts["draft_export_dir"] = args.decode_draft_export_dir
+        opts["speculate_k"] = args.decode_speculate_k
     return opts
 
 
@@ -610,12 +725,19 @@ def serve_main(export_dir: str, host: str = "0.0.0.0",
             decode=decode, decode_opts=decode_opts)
         server.start()
         if decode:
-            s0 = server.replicas[0].session
+            r0 = server.replicas[0]
+            s0 = r0.session
+            spec = ("off" if r0.draft_session is None else
+                    f"k={r0.batcher.policy.speculate_k} "
+                    f"draft=v{r0.draft_session.version}")
             print(f"[serving] DECODE v{server.version} x{replicas} "
                   f"replicas on {host}:{port} "
                   f"(window={s0.window}, page_size={s0.cfg.page_size}, "
                   f"max_seqs={s0.cfg.max_seqs}, "
-                  f"prefill_buckets={s0.prefill_buckets})", flush=True)
+                  f"prefill_buckets={s0.prefill_buckets}, "
+                  f"speculation={spec}, prefix_cache="
+                  f"{'on' if s0.prefix_cache is not None else 'off'})",
+                  flush=True)
         else:
             print(f"[serving] v{server.version} x{replicas} replicas "
                   f"on {host}:{port} (max_batch={max_batch}, "
@@ -656,6 +778,20 @@ def main(argv=None) -> int:
                     metavar="N,N,...",
                     help="padded prompt-length buckets (default powers "
                          "of two up to min(512, max_len))")
+    ap.add_argument("--decode-draft-export-dir", default=None,
+                    metavar="DIR",
+                    help="speculative decoding: a small decode-capable "
+                         "export that proposes tokens the target "
+                         "verifies k-at-a-time in one bucketed step "
+                         "(docs/SERVING.md 'Speculative decode'); "
+                         "dims may differ, vocab must match")
+    ap.add_argument("--decode-speculate-k", type=int, default=4,
+                    help="draft tokens per speculative round (needs "
+                         "--decode-draft-export-dir)")
+    ap.add_argument("--decode-no-prefix-cache", action="store_true",
+                    help="disable the cross-request prefix cache "
+                         "(copy-on-write KV page sharing; on by "
+                         "default — docs/SERVING.md 'Prefix cache')")
     ap.add_argument("--platform", default=None,
                     help="jax platform (e.g. 'cpu')")
     ap.add_argument("--compilation-cache-dir", default=None, metavar="DIR",

@@ -13,6 +13,15 @@ compares different arithmetic.  One helper, one definition:
 * **tokens/s** divides by the measurement wall window;
 * **tokens/s/chip** divides further by the participating chip count —
   the BASELINE.md comparison axis (r3: 157k tok/s/chip).
+
+Speculative decoding adds a second axis the two benches must also
+agree on (``speculative_accounting``): a served token is an EMITTED
+token — the accepted draft prefix plus the verify step's own argmax —
+so ``tokens`` above is unchanged by speculation; REJECTED draft
+tokens are compute spent, never output, and are excluded from both
+the throughput number and the inter-token SLO histogram (as is each
+stream's first token, which is queue+prefill latency — see
+``decode/scheduler.py _emit_token``).
 """
 
 from __future__ import annotations
@@ -36,4 +45,24 @@ def token_throughput(tokens: int, wall_s: float,
         "n_chips": n_chips,
         "tokens_per_sec": rate,
         "tokens_per_sec_per_chip": rate / n_chips,
+    }
+
+
+def speculative_accounting(emitted: int, drafted: int,
+                           accepted: int) -> dict:
+    """The canonical speculative-decode record both the scheduler's
+    ``stats()`` and ``bench_serving --decode`` embed.
+
+    ``emitted`` — tokens actually produced (the throughput axis,
+    identical to the non-speculative count for the same request);
+    ``drafted`` — draft proposals made (k per sequence per round);
+    ``accepted`` — proposals the verify step kept.  ``accept_rate`` is
+    accepted/drafted (None before any speculation, not a fake 0.0)."""
+    emitted, drafted = int(emitted), int(drafted)
+    accepted = int(accepted)
+    return {
+        "emitted_tokens": emitted,
+        "draft_tokens": drafted,
+        "accepted_draft_tokens": accepted,
+        "accept_rate": accepted / drafted if drafted else None,
     }

@@ -21,6 +21,22 @@ latency p50/p99** from the server's own per-token histogram, measured
 under overload when the open-loop rate exceeds capacity.  The smoke
 artifact lives at ``artifacts/BENCH_decode_smoke.json``.
 
+Prompt-heavy trace (``--decode --mode trace``): S streams whose
+prompts share a ``--shared-prefix``-token system prefix and append
+long-tail suffixes (``--tail-lengths``), each generating
+``--gen-tokens`` — the workload the two token-throughput multipliers
+exist for.  Reports **per-stream tok/s** (tokens / that stream's own
+wall, queue included) and the server's accept-rate / prefix-cache
+counters.  ``--spec-compare`` runs the SAME trace twice on fresh
+in-process servers — baseline (no draft, prefix cache off) vs
+optimized (speculative decoding + prefix cache) — verifies the two
+legs' outputs are byte-identical, and emits one JSON with both legs
+plus the per-stream speedup (committed:
+``artifacts/BENCH_decode_spec.json``).  The demo draft is the target
+re-exported at bf16 (self-speculation: same argmax almost always, so
+it measures the accept machinery honestly; a real deployment exports
+a separately trained smaller draft).
+
 Emits one ``BENCH_serving`` JSON (throughput, latency p50/p95/p99,
 batch occupancy / decode sharing from the server's own stats, overload
 counts) to ``--out`` and prints it — same artifact discipline as the
@@ -71,9 +87,16 @@ def _percentiles(ms: list[float]) -> dict:
             "p95": pick(0.95), "p99": pick(0.99), "max": float(a[-1])}
 
 
-def _demo_export(tmp_dir: str, decode: bool = False) -> str:
+def _demo_export(tmp_dir: str, decode: bool = False,
+                 d_model: int = 32, n_layers: int = 2,
+                 n_heads: int = 2, vocab: int = 64,
+                 seq_len: int = 32, draft: str | None = None):
     """Export an untrained tiny model so the tool runs anywhere:
-    TinyCifar for eval mode, a small TransformerLM for --decode."""
+    TinyCifar for eval mode, a small TransformerLM for --decode
+    (dims CLI-sized so the trace mode can make prefill compute-bound
+    on the CPU box).  ``draft='bf16'`` additionally exports the same
+    net quantized as the speculative draft (self-speculation) and
+    returns (export_dir, draft_dir)."""
     from theanompi_tpu.models.base import ModelConfig
     from theanompi_tpu.serving import export_model
 
@@ -84,9 +107,9 @@ def _demo_export(tmp_dir: str, decode: bool = False) -> str:
                           compute_dtype="float32", optimizer="adamw",
                           learning_rate=1e-3, weight_decay=0.0,
                           lr_schedule="constant")
-        model = TransformerLM(config=cfg, vocab=64, seq_len=32,
-                              n_layers=2, d_model=32, n_heads=2,
-                              verbose=False)
+        model = TransformerLM(config=cfg, vocab=vocab, seq_len=seq_len,
+                              n_layers=n_layers, d_model=d_model,
+                              n_heads=n_heads, verbose=False)
     else:
         from tests._tiny_models import TinyCifar
 
@@ -95,7 +118,133 @@ def _demo_export(tmp_dir: str, decode: bool = False) -> str:
                           verbose=False)
     export_dir = os.path.join(tmp_dir, "export")
     export_model(model, export_dir, version=0)
-    return export_dir
+    if not draft:
+        return export_dir
+    draft_dir = os.path.join(tmp_dir, "draft")
+    export_model(model, draft_dir, version=0, weight_dtype="bf16")
+    return export_dir, draft_dir
+
+
+def _demo_trained_exports(tmp_dir: str, args):
+    """Target + genuinely-smaller-draft demo exports for the trace
+    mode's honest configuration: BOTH nets train
+    ``--demo-train-epochs`` epochs on the synthetic successor-table
+    LM task (data/lm.py, noise=0.15 so each learns a Markov rule
+    robust to off-chain context) — after which the small draft agrees
+    with the target on greedy rollouts because both learned the same
+    table, which is exactly the regime speculative decoding is for.
+    Returns (export_dir, draft_dir)."""
+    from theanompi_tpu.data.lm import SeqLM_data
+    from theanompi_tpu.models.base import ModelConfig
+    from theanompi_tpu.models.transformer import TransformerLM
+    from theanompi_tpu.rules.bsp import run_bsp_session
+    from theanompi_tpu.serving import export_model
+
+    def build(d_model, n_layers, n_heads):
+        cfg = ModelConfig(batch_size=16,
+                          n_epochs=args.demo_train_epochs,
+                          print_freq=0, compute_dtype="float32",
+                          optimizer="adamw", learning_rate=3e-3,
+                          weight_decay=0.0, lr_schedule="constant")
+        data = SeqLM_data(vocab=args.demo_vocab,
+                          seq_len=args.demo_seq_len, n_train=512,
+                          n_val=64, seed=0, noise=0.15)
+        return TransformerLM(config=cfg, vocab=args.demo_vocab,
+                             seq_len=args.demo_seq_len,
+                             n_layers=n_layers, d_model=d_model,
+                             n_heads=n_heads, verbose=False, data=data)
+
+    target = build(args.demo_d_model, args.demo_layers,
+                   args.demo_heads)
+    run_bsp_session(target, checkpoint=False)
+    draft = build(args.demo_draft_d_model, args.demo_draft_layers,
+                  args.demo_draft_heads)
+    run_bsp_session(draft, checkpoint=False)
+    export_dir = os.path.join(tmp_dir, "export")
+    draft_dir = os.path.join(tmp_dir, "draft")
+    export_model(target, export_dir, version=0)
+    export_model(draft, draft_dir, version=0)
+    return export_dir, draft_dir
+
+
+def make_trace(shared_prefix: int, tail_lengths: list[int],
+               streams: int, vocab: int, seed: int = 0) -> list:
+    """The prompt-heavy trace: every stream's prompt = one shared
+    system prefix + its own long-tail suffix (lengths cycled from
+    ``tail_lengths``).  Deterministic, so compare legs replay
+    byte-identical prompts."""
+    rng = np.random.default_rng(seed)
+    top = max(2, vocab - 1)
+    prefix = (rng.integers(0, top, shared_prefix).astype(np.int32) + 1
+              if shared_prefix else np.zeros((0,), np.int32))
+    prompts = []
+    for i in range(streams):
+        tail = rng.integers(0, top,
+                            tail_lengths[i % len(tail_lengths)])
+        prompts.append(np.concatenate(
+            [prefix, tail.astype(np.int32) + 1]))
+    return prompts
+
+
+def run_trace(addr: str, prompts: list, gen_tokens: int,
+              concurrency: int) -> dict:
+    """Drive one stream per prompt (own connection each — the server's
+    admission bound, not a client pool, is what saturates), at most
+    ``concurrency`` in flight.  Per-stream wall includes queueing —
+    the number a user's stream actually experiences."""
+    from theanompi_tpu.serving import InferenceClient, Overloaded
+
+    sem = threading.Semaphore(concurrency)
+    lock = threading.Lock()
+    streams: list[dict | None] = [None] * len(prompts)
+    counts = {"ok": 0, "overloaded": 0, "errors": 0}
+
+    def one(i: int) -> None:
+        with sem:
+            t0 = time.monotonic()
+            client = InferenceClient(addr)
+            try:
+                out = client.generate(prompts[i], gen_tokens)
+            except Overloaded:
+                with lock:
+                    counts["overloaded"] += 1
+                return
+            except Exception:
+                with lock:
+                    counts["errors"] += 1
+                return
+            finally:
+                client.close()
+            wall = time.monotonic() - t0
+            with lock:
+                counts["ok"] += 1
+                streams[i] = {"wall_s": wall, "tokens": len(out),
+                              "prompt_tokens": int(prompts[i].shape[0]),
+                              "out": [int(t) for t in out]}
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    done = [s for s in streams if s is not None]
+    per_stream = [s["tokens"] / s["wall_s"] for s in done
+                  if s["wall_s"] > 0]
+    return {
+        "wall_s": wall,
+        "streams": streams,
+        "tokens": sum(s["tokens"] for s in done),
+        "tok_s_per_stream": {
+            "mean": float(np.mean(per_stream)) if per_stream else 0.0,
+            "p50": float(np.median(per_stream)) if per_stream else 0.0,
+            "min": float(np.min(per_stream)) if per_stream else 0.0,
+            "max": float(np.max(per_stream)) if per_stream else 0.0,
+        },
+        **counts,
+    }
 
 
 def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
@@ -187,6 +336,158 @@ def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
             "throughput_rps": counts["ok"] / wall if wall else 0.0}
 
 
+def _start_decode_server(export_dir: str, args, draft_dir: str | None,
+                         prefix_cache: bool):
+    from theanompi_tpu.serving import InferenceServer, serve
+
+    decode_opts = dict(
+        max_seqs=args.decode_max_seqs,
+        max_pending=args.decode_max_pending,
+        page_size=args.decode_page_size,
+        pages_per_seq=args.decode_pages_per_seq,
+        prefix_cache=prefix_cache)
+    if args.decode_prefill_buckets:
+        decode_opts["prefill_buckets"] = tuple(
+            int(b) for b in args.decode_prefill_buckets.split(","))
+    if draft_dir:
+        decode_opts["draft_export_dir"] = draft_dir
+        decode_opts["speculate_k"] = args.speculate_k
+    server = InferenceServer(export_dir, replicas=args.replicas,
+                             decode=True, decode_opts=decode_opts,
+                             reload_poll_s=0).start()
+    port = _free_port()
+    ready = threading.Event()
+    thread = threading.Thread(
+        target=serve, args=(server, "127.0.0.1", port, ready),
+        daemon=True)
+    thread.start()
+    assert ready.wait(60), "server never came up"
+    return server, thread, f"127.0.0.1:{port}"
+
+
+def trace_main(args, tmp_dir: str) -> dict:
+    """The prompt-heavy trace: one leg honoring the flags, or — with
+    ``--spec-compare`` — baseline vs optimized legs on fresh
+    in-process servers, byte-identity-checked (module docstring)."""
+    from theanompi_tpu.serving import InferenceClient, load_export
+    from theanompi_tpu.utils.token_accounting import token_throughput
+
+    export_dir = args.export_dir
+    draft_dir = args.draft_export_dir
+    if export_dir is None:
+        if not args.demo:
+            raise SystemExit(
+                "--mode trace needs --export-dir or --demo (it "
+                "starts its own in-process servers)")
+        if args.demo_train_epochs > 0:
+            export_dir, draft_dir = _demo_trained_exports(tmp_dir,
+                                                          args)
+        else:
+            export_dir, draft_dir = _demo_export(
+                tmp_dir, decode=True, d_model=args.demo_d_model,
+                n_layers=args.demo_layers, n_heads=args.demo_heads,
+                vocab=args.demo_vocab, seq_len=args.demo_seq_len,
+                draft="bf16")
+    meta = load_export(export_dir).meta
+    vocab = int((meta.get("net") or {}).get("vocab", 64))
+    tails = [int(x) for x in args.tail_lengths.split(",")]
+    prompts = make_trace(args.shared_prefix, tails, args.streams,
+                         vocab)
+    if args.spec_compare:
+        if draft_dir is None:
+            raise SystemExit(
+                "--spec-compare needs a draft: pass "
+                "--draft-export-dir with --export-dir, or use --demo "
+                "(which exports one) — otherwise the 'optimized' leg "
+                "would silently run without speculation")
+        plan = (("baseline", False, False), ("optimized", True, True))
+    else:
+        plan = (("trace", bool(draft_dir),
+                 not args.no_prefix_cache),)
+    legs = {}
+    for name, use_draft, use_prefix in plan:
+        server, thread, addr = _start_decode_server(
+            export_dir, args, draft_dir if use_draft else None,
+            use_prefix)
+        try:
+            probe = InferenceClient(addr)
+            # warm pass: compiles every (bucket, family) the trace
+            # touches and seeds the prefix cache — the measured pass
+            # is the steady state users live in
+            run_trace(addr, prompts, args.gen_tokens,
+                      args.concurrency)
+            warm_compiles = [
+                {"target": r.get("compiles"),
+                 "draft": r.get("draft_compiles")}
+                for r in probe.stats()["replicas"]]
+            res = run_trace(addr, prompts, args.gen_tokens,
+                            args.concurrency)
+            st = probe.stats()
+            probe.shutdown()
+            probe.close()
+        finally:
+            server.stop()
+            thread.join(timeout=10)
+        measured_compiles = [
+            {"target": r.get("compiles"),
+             "draft": r.get("draft_compiles")}
+            for r in st["replicas"]]
+        legs[name] = {
+            "speculative": use_draft,
+            "prefix_cache": use_prefix,
+            "tok_s_per_stream": res["tok_s_per_stream"],
+            "throughput": token_throughput(res["tokens"],
+                                           res["wall_s"]),
+            "wall_s": res["wall_s"],
+            "ok": res["ok"], "overloaded": res["overloaded"],
+            "errors": res["errors"],
+            "outputs": [s["out"] if s else None
+                        for s in res["streams"]],
+            "server": {
+                "tokens": st.get("tokens"),
+                "steps": st.get("steps"),
+                "mean_tokens_per_step": (st["tokens"] / st["steps"]
+                                         if st.get("steps") else None),
+                "accept_rate": st.get("accept_rate"),
+                "prefix_cache_hits": st.get("prefix_cache_hits"),
+                "intertoken_ms": (st["replicas"][0] or {}).get(
+                    "intertoken_ms"),
+            },
+            # steady-state pin: the measured pass may not compile
+            # anything the warm pass did not
+            "zero_steady_state_recompiles":
+                warm_compiles == measured_compiles,
+            "compiles": measured_compiles,
+        }
+    out = {
+        "bench": "serving",
+        "mode": "trace",
+        "decode": True,
+        "argv": sys.argv[1:],
+        "trace": {
+            "streams": args.streams,
+            "shared_prefix_tokens": args.shared_prefix,
+            "tail_lengths": tails,
+            "gen_tokens_per_stream": args.gen_tokens,
+            "concurrency": args.concurrency,
+            "speculate_k": args.speculate_k,
+        },
+        "model": {"net": meta.get("net"),
+                  "weight_dtype": meta.get("weight_dtype")},
+        "legs": {name: {k: v for k, v in leg.items()
+                        if k != "outputs"}
+                 for name, leg in legs.items()},
+    }
+    if args.spec_compare:
+        base, opt = legs["baseline"], legs["optimized"]
+        out["byte_identical_output"] = (base["outputs"]
+                                        == opt["outputs"])
+        b = base["tok_s_per_stream"]["mean"]
+        o = opt["tok_s_per_stream"]["mean"]
+        out["per_stream_speedup"] = o / b if b else None
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--addr", default=None,
@@ -196,8 +497,11 @@ def main(argv=None) -> int:
     ap.add_argument("--demo", action="store_true",
                     help="export an untrained TinyCifar to a temp dir "
                          "first (self-contained CPU run)")
-    ap.add_argument("--mode", choices=("closed", "open"),
-                    default="closed")
+    ap.add_argument("--mode", choices=("closed", "open", "trace"),
+                    default="closed",
+                    help="closed/open loop, or 'trace' — the decode "
+                         "prompt-heavy trace (shared prefix x many "
+                         "streams, per-stream tok/s)")
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--rate", type=float, default=100.0,
                     help="open-loop arrival rate, req/s")
@@ -222,8 +526,69 @@ def main(argv=None) -> int:
     ap.add_argument("--decode-max-pending", type=int, default=32,
                     help="--decode in-process server: admission bound "
                          "(prompts beyond it get Overloaded)")
+    ap.add_argument("--decode-page-size", type=int, default=16,
+                    help="--decode in-process trace server: tokens "
+                         "per KV page")
+    ap.add_argument("--decode-pages-per-seq", type=int, default=8,
+                    help="--decode in-process trace server: pages per "
+                         "sequence (window = page_size x pages)")
+    ap.add_argument("--decode-prefill-buckets", default=None,
+                    metavar="N,N,...",
+                    help="--decode in-process trace server: padded "
+                         "prompt-length buckets")
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="--mode trace: shared system-prefix tokens "
+                         "prepended to every stream's prompt")
+    ap.add_argument("--tail-lengths", default="1,2,4,8,16",
+                    help="--mode trace: long-tail per-stream prompt "
+                         "suffix lengths, cycled")
+    ap.add_argument("--streams", type=int, default=16,
+                    help="--mode trace: generation streams in the "
+                         "trace")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="--mode trace: max streams in flight")
+    ap.add_argument("--speculate-k", type=int, default=4,
+                    help="draft tokens per speculative round")
+    ap.add_argument("--draft-export-dir", default=None,
+                    help="speculative draft export for the in-process "
+                         "server (--demo exports a bf16 self-draft)")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="--mode trace single leg: disable the prefix "
+                         "cache")
+    ap.add_argument("--spec-compare", action="store_true",
+                    help="--mode trace: run baseline (no draft, no "
+                         "prefix cache) and optimized (both on) legs "
+                         "over the SAME trace, verify byte-identical "
+                         "outputs, report the per-stream speedup")
+    ap.add_argument("--demo-d-model", type=int, default=32)
+    ap.add_argument("--demo-layers", type=int, default=2)
+    ap.add_argument("--demo-heads", type=int, default=2)
+    ap.add_argument("--demo-vocab", type=int, default=64)
+    ap.add_argument("--demo-seq-len", type=int, default=32)
+    ap.add_argument("--demo-train-epochs", type=int, default=0,
+                    help="--mode trace --demo: train target AND a "
+                         "smaller draft net this many epochs on the "
+                         "synthetic successor-table LM task before "
+                         "exporting (0 = untrained target with a bf16 "
+                         "self-draft)")
+    ap.add_argument("--demo-draft-d-model", type=int, default=64)
+    ap.add_argument("--demo-draft-layers", type=int, default=1)
+    ap.add_argument("--demo-draft-heads", type=int, default=2)
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
+
+    if args.mode == "trace":
+        if not args.decode:
+            ap.error("--mode trace is a --decode mode")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            out = trace_main(args, td)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out, indent=1))
+        print(f"BENCH_serving written to {args.out}")
+        return 0
 
     import tempfile
 

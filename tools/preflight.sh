@@ -194,13 +194,16 @@ PYEOF
   SERVING_RC=$?
   rm -rf "$SERVEDIR"
   echo "serving smoke rc=$SERVING_RC"
-  echo "## decode smoke (LM export -> decode server -> 2 concurrent streams, docs/SERVING.md 'Decode')"
+  echo "## decode smoke (LM+draft exports -> speculative decode server -> shared-prefix streams, docs/SERVING.md 'Decode'/'Speculative decode'/'Prefix cache')"
   # the autoregressive vertical end-to-end on CPU: export a tiny
-  # TransformerLM, serve it in decode mode on a real socket, drive two
-  # concurrent generate streams; at least one decode step must batch
-  # rows from BOTH sequences (iteration-level sharing), both streams
-  # must match the uncached full-forward argmax oracle, and the
-  # inter-token histogram must land in the monitor JSONL
+  # TransformerLM AND a bf16 self-draft, serve in decode mode with
+  # speculation + prefix cache on a real socket, drive a warm stream
+  # then two concurrent streams sharing its page-aligned prompt
+  # prefix; at least one decode step must batch rows from BOTH
+  # sequences (iteration-level sharing), every stream must match the
+  # uncached full-forward argmax oracle, speculation must accept at
+  # least one draft (accept-rate > 0), the prefix-cache hit counter
+  # must land in the monitor JSONL, and the inter-token histogram too
   DECODEDIR="$(mktemp -d)"
   JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$DECODEDIR" python - <<'PYEOF'
 import json, os, socket, threading
@@ -225,13 +228,19 @@ model = TransformerLM(config=cfg, vocab=32, seq_len=16, n_layers=2,
                       d_model=16, n_heads=2, verbose=False)
 params = jax.device_get(model.state.params)
 export_dir = os.path.join(mondir, "export")
+draft_dir = os.path.join(mondir, "draft")
 export_model(model, export_dir, version=0)
+# bf16 self-draft: same net quantized — near-total greedy agreement,
+# so the accept machinery is exercised without a training run
+export_model(model, draft_dir, version=0, weight_dtype="bf16")
 with monitor.session(run_dir=mondir, stall_after=float("inf")):
     server = InferenceServer(
         export_dir, replicas=1, reload_poll_s=0, model=model,
         decode=True,
         decode_opts=dict(page_size=4, pages_per_seq=8, max_seqs=4,
-                         prefill_buckets=(8,))).start()
+                         prefill_buckets=(8,),
+                         draft_export_dir=draft_dir,
+                         speculate_k=3)).start()
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -242,10 +251,28 @@ with monitor.session(run_dir=mondir, stall_after=float("inf")):
     t.start()
     assert ready.wait(30)
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, 32, 5).astype(np.int32),
-               rng.integers(0, 32, 7).astype(np.int32)]
-    outs = [None, None]
+    base = rng.integers(0, 32, 4).astype(np.int32)   # shared page
+    warm_prompt = np.concatenate(
+        [base, rng.integers(0, 32, 1).astype(np.int32)])
+    prompts = [np.concatenate(
+        [base, rng.integers(0, 32, n).astype(np.int32)])
+        for n in (2, 3)]
+    def oracle(p, n):
+        cur = [int(x) for x in p]
+        out = []
+        for _ in range(n):
+            lg = np.asarray(model.module.apply(
+                {"params": params}, jnp.asarray([cur], jnp.int32),
+                train=False, seq_axis=None))
+            tok = int(np.argmax(lg[0, -1])); out.append(tok)
+            cur.append(tok)
+        return out
     clients = [InferenceClient(f"127.0.0.1:{port}") for _ in range(2)]
+    # warm stream completes first: registers the shared prefix so the
+    # concurrent pair deterministically hits it
+    warm_out = clients[0].generate(warm_prompt, 10)
+    assert list(warm_out) == oracle(warm_prompt, 10)
+    outs = [None, None]
     ths = [threading.Thread(
         target=lambda i=i: outs.__setitem__(
             i, clients[i].generate(prompts[i], 10))) for i in range(2)]
@@ -253,20 +280,15 @@ with monitor.session(run_dir=mondir, stall_after=float("inf")):
         th.start()
     for th in ths:
         th.join(120)
-    # both streams token-identical to the uncached flax oracle
+    # every stream token-identical to the uncached flax oracle
     for p, o in zip(prompts, outs):
-        cur = [int(x) for x in p]
-        oracle = []
-        for _ in range(10):
-            lg = np.asarray(model.module.apply(
-                {"params": params}, jnp.asarray([cur], jnp.int32),
-                train=False, seq_axis=None))
-            tok = int(np.argmax(lg[0, -1])); oracle.append(tok)
-            cur.append(tok)
-        assert o is not None and list(o) == oracle, (o, oracle)
+        assert o is not None and list(o) == oracle(p, 10), (o, p)
     st = clients[0].stats()
     assert st["decode"] is True
     assert st["shared_steps"] >= 1, f"no shared decode step: {st}"
+    assert st["accept_rate"] and st["accept_rate"] > 0, \
+        f"speculation accepted nothing: {st}"
+    assert st["prefix_cache_hits"] >= 1, f"no prefix hit: {st}"
     clients[0].shutdown()
     for c in clients:
         c.close()
@@ -276,14 +298,22 @@ recs = [json.loads(l)
         for l in open(os.path.join(mondir, "metrics_rank0.jsonl"))]
 names = {r["name"] for r in recs}
 missing = {"decode/intertoken_ms", "decode/tokens_total",
-           "decode/steps_total"} - names
+           "decode/steps_total", "decode/accept_rate",
+           "decode/draft_tokens_total",
+           "decode/prefix_cache_hits_total"} - names
 assert not missing, f"snapshot missing decode series: {missing}"
 itl = next(r for r in recs if r["name"] == "decode/intertoken_ms")
-# 2 streams x 10 tokens, minus each stream's FIRST token (prefill's
-# output: queue+prefill latency, excluded from the inter-token SLO)
-assert itl["count"] == 18 and "p99" in itl, itl
+# 3 streams x 10 tokens, minus each stream's FIRST token (prefill's
+# output: queue+prefill latency, excluded from the inter-token SLO);
+# rejected draft tokens never enter the histogram either
+assert itl["count"] == 27 and "p99" in itl, itl
+hits = next(r for r in recs
+            if r["name"] == "decode/prefix_cache_hits_total")
+assert hits["value"] >= 1, hits
 print(f"decode smoke OK: shared_steps={st['shared_steps']}, "
       f"{st['tokens']} tokens / {st['steps']} steps, "
+      f"accept_rate {st['accept_rate']:.2f}, "
+      f"prefix hits {st['prefix_cache_hits']}, "
       f"intertoken p99 {itl['p99']:.1f}ms in monitor JSONL")
 PYEOF
   DECODE_RC=$?
