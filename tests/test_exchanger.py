@@ -343,3 +343,316 @@ def test_gosgd_dominant_push_resets_momentum():
     mom = [v for k, v in _named_leaves(scaled).items()
            if _has_field(k, "trace")]
     assert mom and float(jnp.abs(mom[0]).max()) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# Bucketed exchange (ISSUE 13): layer-ordered byte-balanced buckets,
+# collectives embedded in the backward DAG, B-count equivalence pins.
+# ---------------------------------------------------------------------------
+
+
+class TestBucketPlan:
+    def test_plan_pure_balanced_contiguous(self):
+        from theanompi_tpu.parallel.exchanger import bucket_ranges
+
+        sizes = [4 * n for n in (7, 7, 3, 64, 64, 64, 64, 1, 4096, 10)]
+        for B in (1, 2, 4, 8):
+            plan = bucket_ranges(sizes, B)
+            # purity: identical on a second derivation (every rank
+            # computes its own plan — no plan ever travels on a wire)
+            assert plan == bucket_ranges(list(sizes), B)
+            # contiguity + full cover, in order (layer order IS
+            # flatten order)
+            assert plan[0][0] == 0 and plan[-1][1] == len(sizes)
+            for (_, hi), (lo2, _) in zip(plan, plan[1:]):
+                assert hi == lo2
+            assert all(hi > lo for lo, hi in plan)
+            # byte balance: the greedy quantile walk never exceeds a
+            # quantile target by more than one leaf
+            per = [sum(sizes[lo:hi]) for lo, hi in plan]
+            assert max(per) <= sum(sizes) / len(plan) + max(sizes)
+
+    def test_plan_clamps_beyond_leaf_count(self):
+        from theanompi_tpu.parallel.exchanger import bucket_ranges
+
+        # a bucket plan is a scheduling hint: B > n_leaves degrades to
+        # per-leaf buckets instead of raising like the shard plan
+        assert bucket_ranges([8, 8, 8], 64) == [(0, 1), (1, 2), (2, 3)]
+
+    def test_plan_shares_the_shard_partition_walk(self):
+        from theanompi_tpu.parallel.exchanger import bucket_ranges
+        from theanompi_tpu.parallel.shards import partition_ranges
+
+        sizes = [3, 100, 7, 42, 42, 9, 512, 1]
+        for k in (1, 2, 4):
+            assert bucket_ranges(sizes, k) == partition_ranges(sizes, k)
+
+    def test_exchanger_validates_bucket_count(self):
+        for bad in (0, -1, 1.5, True):
+            with pytest.raises(ValueError, match="exchange_buckets"):
+                BSP_Exchanger(exchange_buckets=bad)
+
+
+class TestBucketedPostHocExchange:
+    """exchange()/exchange_with_residual() with B>1 regroup the
+    per-leaf collectives into per-bucket flat ones — elementwise
+    identical (no per-element sum moves)."""
+
+    def test_exchange_bit_identical_across_bucket_counts(self, mesh8):
+        rng = np.random.RandomState(7)
+        tree = {f"l{i:02d}": rng.randn(8, 3 + i).astype(np.float32)
+                for i in range(6)}
+        for dtype in (None, "bf16"):
+            ref = _run_exchange(mesh8,
+                                BSP_Exchanger(exchange_dtype=dtype,
+                                              avg=True), tree)
+            for B in (2, 4, 8):
+                out = _run_exchange(
+                    mesh8, BSP_Exchanger(exchange_dtype=dtype, avg=True,
+                                         exchange_buckets=B), tree)
+                for a, b in zip(jax.tree.leaves(ref),
+                                jax.tree.leaves(out)):
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+
+    def test_exchange_with_residual_bucketed_identical(self, mesh8):
+        from jax.sharding import PartitionSpec
+
+        rng = np.random.RandomState(9)
+        tree = {f"l{i}": rng.randn(8, 16).astype(np.float32)
+                for i in range(4)}
+        res = jax.tree.map(lambda x: np.zeros_like(x), tree)
+
+        def run(B):
+            ex = BSP_Exchanger(exchange_dtype="bf16",
+                               error_feedback=True, avg=True,
+                               exchange_buckets=B)
+            f = jax.jit(jax.shard_map(
+                ex.exchange_with_residual, mesh=mesh8,
+                in_specs=(PartitionSpec(AXIS_DATA),) * 2,
+                out_specs=(PartitionSpec(AXIS_DATA),) * 2,
+                check_vma=False))
+            return f(tree, res)
+
+        out1, res1 = run(1)
+        for B in (2, 4):
+            outB, resB = run(B)
+            for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(outB)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(res1), jax.tree.leaves(resB)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _bucket_loss(params, model_state, batch, rng):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    pred = h @ params["w2"] + params["b2"]
+    loss = jnp.mean((pred - y) ** 2)
+    return loss, (model_state, {"loss": loss, "error": loss})
+
+
+def _bucket_params():
+    k = jax.random.split(jax.random.key(3), 2)
+    return {"w1": jax.random.normal(k[0], (6, 9)) * 0.3,
+            "b1": jnp.zeros(9),
+            "w2": jax.random.normal(k[1], (9, 2)) * 0.3,
+            "b2": jnp.zeros(2)}
+
+
+def _bucket_batch(mesh8):
+    from theanompi_tpu.parallel.mesh import shard_batch
+
+    rng_np = np.random.default_rng(5)
+    x = rng_np.standard_normal((32, 6)).astype(np.float32)
+    y = rng_np.standard_normal((32, 2)).astype(np.float32)
+    return shard_batch((x, y), mesh8)
+
+
+class TestBucketedTrainStep:
+    """The acceptance pins: B>1 equal to B=1 at EVERY step, plain and
+    error-feedback variants, with the collectives embedded in the
+    backward (HLO pin below)."""
+
+    def _run(self, mesh8, B, dtype=None, ef=False, steps=3):
+        import optax
+
+        from theanompi_tpu.parallel.bsp import (
+            TrainState,
+            init_exchange_residual,
+            make_bsp_train_step,
+        )
+
+        params = _bucket_params()
+        tx = optax.sgd(0.05, momentum=0.9)
+        ex = BSP_Exchanger(exchange_dtype=dtype, error_feedback=ef,
+                           exchange_buckets=B, avg=True)
+        step = make_bsp_train_step(_bucket_loss, tx, mesh8, ex,
+                                   donate=False)
+        s = TrainState.create(params, tx)
+        if ef:
+            s = s.replace(
+                exchange_residual=init_exchange_residual(params, 8))
+        batch = _bucket_batch(mesh8)
+        rng = jax.random.key(1)
+        traj = []
+        for _ in range(steps):
+            s, m = step(s, batch, rng)
+            traj.append(jax.tree.map(np.asarray, s.params))
+        return s, m, traj
+
+    @pytest.mark.parametrize("dtype,ef", [(None, False), ("bf16", False),
+                                          ("bf16", True)])
+    def test_bucketed_step_bit_identical_per_step(self, mesh8, dtype, ef):
+        s1, m1, traj1 = self._run(mesh8, 1, dtype, ef)
+        for B in (2, 4, 8):
+            sB, mB, trajB = self._run(mesh8, B, dtype, ef)
+            for t1, tB in zip(traj1, trajB):  # EVERY step, not just last
+                for a, b in zip(jax.tree.leaves(t1), jax.tree.leaves(tB)):
+                    np.testing.assert_array_equal(a, b, err_msg=f"B={B}")
+            assert float(m1["loss"]) == float(mB["loss"])
+            if ef:
+                for a, b in zip(jax.tree.leaves(s1.exchange_residual),
+                                jax.tree.leaves(sB.exchange_residual)):
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+
+    def test_bucketed_cadences_bit_identical(self, mesh8):
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        from theanompi_tpu.parallel.bsp import (
+            TrainState,
+            make_bsp_accum_step,
+            make_bsp_multi_step,
+        )
+        from theanompi_tpu.parallel.mesh import shard_batch
+
+        params = _bucket_params()
+        tx = optax.sgd(0.05, momentum=0.9)
+        rng_np = np.random.default_rng(6)
+        xs = rng_np.standard_normal((2, 32, 6)).astype(np.float32)
+        ys = rng_np.standard_normal((2, 32, 2)).astype(np.float32)
+        stacked = shard_batch((xs, ys), mesh8, spec=P(None, "data"))
+        for maker in (make_bsp_multi_step, make_bsp_accum_step):
+            outs = {}
+            for B in (1, 4):
+                ex = BSP_Exchanger(exchange_buckets=B, avg=True)
+                step = maker(_bucket_loss, tx, mesh8, ex, donate=False)
+                s = TrainState.create(params, tx)
+                s, _ = step(s, stacked, jax.random.key(2))
+                outs[B] = s
+            for a, b in zip(jax.tree.leaves(outs[1].params),
+                            jax.tree.leaves(outs[4].params)):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b),
+                                              err_msg=maker.__name__)
+
+    def test_backward_exchange_rejects_params_mode(self):
+        ex = BSP_Exchanger(exchange_what="params", exchange_buckets=2)
+        with pytest.raises(ValueError, match="backward"):
+            ex.backward_exchange(_bucket_loss, {}, {}, None, None)
+
+    def test_bucketed_ef_requires_residual_state(self, mesh8):
+        import optax
+
+        from theanompi_tpu.parallel.bsp import (
+            TrainState,
+            make_bsp_train_step,
+        )
+
+        ex = BSP_Exchanger(exchange_dtype="bf16", error_feedback=True,
+                           exchange_buckets=4, avg=True)
+        step = make_bsp_train_step(_bucket_loss, optax.sgd(0.05), mesh8,
+                                   ex, donate=False)
+        s = TrainState.create(_bucket_params(), optax.sgd(0.05))
+        with pytest.raises(ValueError, match="exchange_residual"):
+            step(s, _bucket_batch(mesh8), jax.random.key(0))
+
+
+class TestBucketedHloInterleaving:
+    """The structural acceptance pin: the bucketed program carries B
+    bucket all-reduces INTERLEAVED with backward compute; the B=1
+    program keeps one trailing collective block after every backward
+    dot."""
+
+    def _lowered(self, mesh8, B):
+        import optax
+
+        from theanompi_tpu.parallel.bsp import (
+            TrainState,
+            make_bsp_train_step,
+        )
+
+        params = _bucket_params()
+        tx = optax.sgd(0.05, momentum=0.9)
+        ex = BSP_Exchanger(exchange_buckets=B, avg=True)
+        step = make_bsp_train_step(_bucket_loss, tx, mesh8, ex,
+                                   donate=False)
+        s = TrainState.create(params, tx)
+        return step.lower(s, _bucket_batch(mesh8),
+                          jax.random.key(0)).as_text()
+
+    @staticmethod
+    def _layout(txt):
+        lines = txt.splitlines()
+        ar = [i for i, l in enumerate(lines)
+              if "stablehlo.all_reduce" in l]
+        dots = [i for i, l in enumerate(lines)
+                if "stablehlo.dot_general" in l]
+        return ar, dots
+
+    def test_bucket_collective_count_and_interleave(self, mesh8):
+        n_leaves = len(jax.tree.leaves(_bucket_params()))
+        ar1, dots1 = self._layout(self._lowered(mesh8, 1))
+        # B=1: one psum per leaf (+ the metric pmeans) — ALL of them
+        # after the last backward dot: one trailing collective block
+        metric_ars = len(ar1) - n_leaves
+        assert metric_ars >= 0
+        assert not [d for d in dots1 if d > ar1[0]], \
+            "B=1 lowering has backward compute after a collective"
+        for B in (2, 4):
+            arB, dotsB = self._layout(self._lowered(mesh8, B))
+            # exactly B bucket collectives (each bucket's leaves are
+            # flattened into ONE all-reduce) + the metric pmeans
+            assert len(arB) == B + metric_ars, (B, len(arB), metric_ars)
+            # interleaving: backward dots appear AFTER the first bucket
+            # collective — the exchange overlaps the remaining backward
+            assert [d for d in dotsB if d > arB[0]], \
+                f"B={B} lowering has no backward compute after the " \
+                "first bucket collective"
+
+    def test_bucket_gauges_emitted_at_trace_time(self, mesh8, tmp_path):
+        import json
+
+        import optax
+
+        from theanompi_tpu import monitor
+        from theanompi_tpu.parallel.bsp import (
+            TrainState,
+            make_bsp_train_step,
+        )
+
+        with monitor.session(run_dir=str(tmp_path)):
+            ex = BSP_Exchanger(exchange_buckets=4, avg=True)
+            step = make_bsp_train_step(_bucket_loss,
+                                       optax.sgd(0.05, momentum=0.9),
+                                       mesh8, ex, donate=False)
+            s = TrainState.create(_bucket_params(),
+                                  optax.sgd(0.05, momentum=0.9))
+            s, _ = step(s, _bucket_batch(mesh8), jax.random.key(0))
+            monitor.flush()
+        recs = [json.loads(l) for l in
+                open(tmp_path / "metrics_rank0.jsonl")]
+        by = {}
+        for r in recs:
+            by.setdefault(r["name"], []).append(r)
+        (bk,) = [r for r in by["bsp/exchange_buckets"]
+                 if r["labels"].get("plane") == "bsp"]
+        assert bk["value"] == 4
+        buckets = {r["labels"]["bucket"]
+                   for r in by["bsp/exchange_bucket_bytes"]}
+        assert buckets == {"0", "1", "2", "3"}
+        total = sum(r["value"] for r in by["bsp/exchange_bucket_bytes"])
+        n_param_bytes = sum(l.size * 4 for l in
+                            jax.tree.leaves(_bucket_params()))
+        assert total == n_param_bytes

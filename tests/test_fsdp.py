@@ -354,3 +354,92 @@ def test_fsdp_lars_equals_unsharded_oracle(mesh8):
                                    rtol=1e-5, atol=1e-6)
     assert float(m_f["loss"]) == pytest.approx(float(m_o["loss"]),
                                                rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Bucketed exchange (ISSUE 13): per-bucket optimization_barrier fences
+# in the backward — GSPMD owns the collectives, the fences pin their
+# per-bucket grouping.  Identity numerics, pinned bit-equal.
+# ---------------------------------------------------------------------------
+
+
+def _run_fsdp_bucketed(mesh8, B, steps=3):
+    tx = build_optimizer(0.05, optimizer="sgd", momentum=0.9,
+                         weight_decay=1e-4)
+    params = _params()
+    specs = fsdp_specs(params, mesh8)
+    s = init_fsdp_state(params, tx, {}, mesh8, specs)
+    step = make_bsp_fsdp_step(_loss, tx, mesh8, params, donate=False,
+                              specs=specs, exchange_buckets=B)
+    batch = shard_batch(_batch(), mesh8)
+    rng = jax.random.key(2)
+    traj = []
+    for _ in range(steps):
+        s, m = step(s, batch, rng)
+        traj.append(jax.tree.map(np.asarray, s.params))
+    return s, m, traj
+
+
+def test_fsdp_bucketed_bit_identical_to_b1(mesh8):
+    """The acceptance pin on the FSDP plane: the barrier tags are the
+    identity — B>1 equals B=1 bit-for-bit at every step."""
+    _, m1, traj1 = _run_fsdp_bucketed(mesh8, 1)
+    for B in (2, 4, 8):
+        _, mB, trajB = _run_fsdp_bucketed(mesh8, B)
+        for t1, tB in zip(traj1, trajB):
+            for a, b in zip(jax.tree.leaves(t1), jax.tree.leaves(tB)):
+                np.testing.assert_array_equal(a, b, err_msg=f"B={B}")
+        assert float(m1["loss"]) == float(mB["loss"])
+
+
+def test_fsdp_bucket_barriers_in_lowering(mesh8):
+    """Structural pin: the bucketed program carries one
+    optimization_barrier per bucket in the backward; the unbucketed
+    one carries none."""
+    from theanompi_tpu.parallel.exchanger import (
+        _leaf_nbytes,
+        bucket_ranges,
+    )
+
+    tx = build_optimizer(0.05, optimizer="sgd", momentum=0.9)
+    params = _params()
+    specs = fsdp_specs(params, mesh8)
+    s = init_fsdp_state(params, tx, {}, mesh8, specs)
+    batch = shard_batch(_batch(), mesh8)
+
+    def barriers(B):
+        step = make_bsp_fsdp_step(_loss, tx, mesh8, params,
+                                  donate=False, specs=specs,
+                                  exchange_buckets=B)
+        txt = step.lower(s, batch, jax.random.key(0)).as_text()
+        return txt.count("stablehlo.optimization_barrier")
+
+    assert barriers(1) == 0
+    leaves = jax.tree.leaves(params)
+    for B in (2, 4):
+        n_buckets = len(bucket_ranges(
+            [_leaf_nbytes(l) for l in leaves], B))
+        assert barriers(B) == n_buckets, (B, n_buckets)
+
+
+def test_fsdp_bucketed_model_glue_and_validation(mesh8):
+    """ModelConfig.exchange_buckets reaches the FSDP stack; bad bucket
+    counts are refused at the builder."""
+    from theanompi_tpu.models.base import ModelConfig
+    from tests._tiny_models import TinyCifar128
+
+    with pytest.raises(ValueError, match="exchange_buckets"):
+        make_bsp_fsdp_step(_loss, build_optimizer(0.05), mesh8,
+                           _params(), exchange_buckets=0)
+    cfg = ModelConfig(batch_size=4, n_epochs=1, print_freq=0,
+                      fsdp_sharding=True, exchange_buckets=4)
+    m = TinyCifar128(config=cfg, mesh=mesh8, verbose=False)
+    m.compile_iter_fns("avg")
+    from theanompi_tpu.utils.recorder import Recorder
+
+    rec = Recorder(rank=0, size=8, print_freq=0)
+    m.begin_epoch(0)
+    m.train_iter(0, rec)
+    m._flush_metrics(rec)
+    assert np.isfinite(rec.train_losses).all()
+    m.cleanup()

@@ -104,6 +104,17 @@ class TestStagedBatchDonation:
         donors, _ = self._donors(mesh8, donate=False)
         assert donors == 0
 
+    def test_bucketed_exchange_keeps_donation(self, mesh8):
+        """ISSUE 13: embedding the bucketed collectives in the backward
+        (custom_vjp boundary tags) must not change what the cadence
+        donates — state leaves AND both batch leaves, same as B=1."""
+        from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+        base, n_state = self._donors(mesh8)
+        bucketed, _ = self._donors(
+            mesh8, exchanger=BSP_Exchanger(exchange_buckets=4, avg=True))
+        assert bucketed == base == n_state + 2
+
     def test_model_config_threads_donate_batch(self, mesh8):
         """ModelConfig.donate_batch reaches the compiled cadence."""
         from tests._tiny_models import TinyCifar128

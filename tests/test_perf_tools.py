@@ -145,3 +145,43 @@ def test_bench_maxpool_smoke():
     assert impls == ["xla", "pallas"]
     assert all(rec["fwd_bwd_ms"] > 0 for rec in recs if "impl" in rec)
     assert recs[-1]["event"] == "summary" and recs[-1]["speedup_pallas"] > 0
+
+
+def test_bench_exchange_buckets_shards_conflict():
+    """ISSUE 13 satellite: --buckets with --shards must fail FAST with
+    the typed FlagConflict (exit 2) instead of silently ignoring one
+    flag, both in-process and as a subprocess."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    try:
+        import bench_exchange
+    finally:
+        sys.path.pop(0)
+    with pytest.raises(bench_exchange.FlagConflict) as ei:
+        bench_exchange.main(["--buckets", "4", "--shards", "2"])
+    assert ei.value.code == 2
+    r = _run_tool([os.path.join(REPO_ROOT, "tools/bench_exchange.py"),
+                   "--buckets", "4", "--shards", "2"], timeout=120)
+    assert r.returncode == 2
+    assert "mutually exclusive" in r.stderr
+
+
+def test_queue_resnet_point_buckets_flag(tmp_path):
+    """The queued bucketed profile pair's lever: --buckets reaches
+    ModelConfig.exchange_buckets and lands in the JSON row (tiny crop
+    wiring-check shape so CPU can afford it)."""
+    env_extra = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools/queue_resnet_point.py"),
+         "--k", "2", "--batch", "2", "--crop", "64", "--steps", "2",
+         "--buckets", "4"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=REPO_ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["exchange_buckets"] == 4
+    assert row["exp"] == "resnet50_wiring"  # shrunken crop never ladders

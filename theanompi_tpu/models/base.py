@@ -139,6 +139,24 @@ class ModelConfig:
     #: and a pure-'data' reduce axis (the residual is per-DATA-shard
     #: state); costs one extra f32 param-sized buffer per device
     exchange_error_feedback: bool = False
+    #: partition the gradient exchange into this many layer-ordered,
+    #: byte-balanced buckets (parallel/exchanger.bucket_ranges — a pure
+    #: plan every rank derives identically) and embed each bucket's
+    #: collective INTO the backward DAG, so early backward segments'
+    #: psums overlap the remaining segments' gradient compute
+    #: (arXiv:1802.06949's bucketed collectives, expressed as
+    #: custom_vjp boundary tags for XLA's latency-hiding scheduler).
+    #: 1 (default) keeps the whole-tree post-backward exchange
+    #: byte-identical.  Works for plain BSP (f32/bf16/error-feedback),
+    #: zero_sharding (per-bucket reduce_scatter/all_to_all — NOTE the
+    #: sharded opt-state/residual layout depends on the bucket count,
+    #: so resume a checkpoint under the SAME value), and fsdp_sharding
+    #: (scheduling fences only; GSPMD owns the collectives).  The
+    #: grad-accum cadence keeps its single post-accumulation exchange,
+    #: split per bucket.  B>1 is pinned step-identical to B=1 on all
+    #: three planes (tests/test_exchanger.py, test_zero.py,
+    #: test_fsdp.py)
+    exchange_buckets: int = 1
     compute_dtype: str = "float32"         # 'bfloat16' -> MXU-friendly compute
     #: crop/flip/normalize on DEVICE (ops/augment.py) — the host ships
     #: raw uint8 and the step augments; False = host-side augmentation
@@ -297,7 +315,9 @@ class TpuModel:
             from theanompi_tpu.parallel.zero import init_zero_opt_state
 
             self._check_zero_supported()
-            opt_state, _ = init_zero_opt_state(self.tx, params, self.mesh)
+            opt_state, _ = init_zero_opt_state(
+                self.tx, params, self.mesh,
+                exchange_buckets=self.config.exchange_buckets)
             params_r, ms_r, step_r = replicate(
                 (params, model_state, jnp.zeros((), jnp.int32)), self.mesh)
             return TrainState(step=step_r, params=params_r,
@@ -337,7 +357,9 @@ class TpuModel:
                 init_zero_exchange_residual,
             )
 
-            res = init_zero_exchange_residual(params, self.mesh)
+            res = init_zero_exchange_residual(
+                params, self.mesh,
+                exchange_buckets=cfg.exchange_buckets)
         else:
             from theanompi_tpu.parallel.bsp import init_exchange_residual
 
@@ -402,6 +424,11 @@ class TpuModel:
             # than refusing
             raise ValueError(f"exchange_error_feedback is not "
                              f"implemented for the {model_kind}")
+        if self.config.exchange_buckets != 1:
+            # custom step builders don't route through the exchanger's
+            # backward tags; a silently-ignored knob would fake the win
+            raise ValueError(f"exchange_buckets is not implemented for "
+                             f"the {model_kind}")
 
     def _check_fsdp_supported(self) -> None:
         from theanompi_tpu.parallel.mesh import AXIS_DATA
@@ -662,7 +689,8 @@ class TpuModel:
             # the step's shardings and the resume placement identical
             fsdp_kw = dict(avg=(sync_type != "cdd"), batch_partition=part,
                            donate_batch=self.config.donate_batch,
-                           specs=self.param_specs)
+                           specs=self.param_specs,
+                           exchange_buckets=self.config.exchange_buckets)
             self.train_step = make_bsp_fsdp_step(
                 self.loss_fn, self.tx, self.mesh,
                 params_template=self.state.params, **fsdp_kw)
@@ -691,7 +719,8 @@ class TpuModel:
                            batch_partition=part, reduce_axes=axes,
                            exchange_dtype=self.config.exchange_dtype,
                            error_feedback=self.config
-                           .exchange_error_feedback)
+                           .exchange_error_feedback,
+                           exchange_buckets=self.config.exchange_buckets)
             self.train_step = make_bsp_zero_step(
                 self.loss_fn, self.tx, self.mesh,
                 params_template=self.state.params,  # shapes only
@@ -718,6 +747,7 @@ class TpuModel:
             exchange_dtype=(None if self.config.exchange_dtype == "f32"
                             else self.config.exchange_dtype),
             error_feedback=self.config.exchange_error_feedback,
+            exchange_buckets=self.config.exchange_buckets,
         )
         self.train_step = make_bsp_train_step(self.loss_fn, self.tx,
                                               self.mesh, exchanger,

@@ -177,8 +177,38 @@ def _make_shard_step(
     and the scanned multi-step builders."""
     exchanger = _default_exchanger(exchanger, reduce_axes)
 
+    def bucketed_step(state: TrainState, batch, rng):
+        # exchange_buckets > 1 grads path: the per-bucket collectives
+        # are embedded in the backward DAG (exchanger.backward_exchange
+        # boundary tags), so grads come back ALREADY exchanged — the
+        # step tail is just BN-stat pmean + optimizer update
+        res = None
+        if exchanger.error_feedback:
+            if state.exchange_residual is None:
+                raise ValueError(
+                    "error_feedback needs state.exchange_residual "
+                    "(init_exchange_residual; models/base.py builds it "
+                    "from ModelConfig.exchange_error_feedback)")
+            res = jax.tree.map(lambda r: r[0], state.exchange_residual)
+        loss, (new_ms, metrics), grads, new_res = (
+            exchanger.backward_exchange(loss_fn, state.params,
+                                        state.model_state, batch, rng,
+                                        residual=res))
+        metrics = dict(metrics)
+        metrics.setdefault("loss", loss)
+        new_ms = _pmean(new_ms, reduce_axes)
+        new_state = apply_update(tx, state, grads, new_ms)
+        if new_res is not None:
+            new_state = new_state.replace(
+                exchange_residual=jax.tree.map(lambda r: r[None],
+                                               new_res))
+        return new_state, _pmean(metrics, reduce_axes)
+
     def shard_step(state: TrainState, batch, rng):
         rng = _fold_axis_rng(rng, reduce_axes)
+        if (exchanger.exchange_what == "grads"
+                and exchanger.exchange_buckets > 1):
+            return bucketed_step(state, batch, rng)
         grads, new_ms, metrics = grad_and_metrics(
             loss_fn, state.params, state.model_state, batch, rng)
 

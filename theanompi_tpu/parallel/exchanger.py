@@ -39,8 +39,61 @@ import jax.numpy as jnp
 
 from theanompi_tpu import monitor
 from theanompi_tpu.parallel.mesh import AXIS_DATA
+from theanompi_tpu.parallel.partition import balanced_ranges
 
 PyTree = Any
+
+
+def bucket_ranges(sizes, n_buckets: int) -> list[tuple[int, int]]:
+    """Layer-ordered, byte-balanced bucket plan over flatten-order
+    leaves: contiguous ``(lo, hi)`` leaf ranges, a pure function of
+    (leaf byte sizes, bucket count) — every rank derives the identical
+    plan from its own model tree, exactly like the shard fleet's
+    ``partition_ranges`` (same greedy walk, ``parallel/partition.py``).
+    Unlike the shard plan, a bucket count beyond the leaf count CLAMPS
+    to per-leaf buckets instead of raising: the bucket plan is a
+    scheduling hint, not an ownership contract."""
+    sizes = list(sizes)
+    return balanced_ranges(sizes, min(int(n_buckets), len(sizes)))
+
+
+def validate_bucket_count(exchange_buckets) -> int:
+    """The ONE contract check for the ``exchange_buckets`` knob (the
+    exchanger and the zero/fsdp step builders all accept it — one
+    validator keeps the three planes' accepted values and error text
+    identical)."""
+    b = exchange_buckets
+    if isinstance(b, bool) or not isinstance(b, int) or b < 1:
+        raise ValueError(
+            f"exchange_buckets must be an int >= 1, got {b!r}")
+    return b
+
+
+def _leaf_nbytes(leaf) -> int:
+    import numpy as np
+
+    size = getattr(leaf, "size", None)
+    if size is None:
+        size = int(np.prod(getattr(leaf, "shape", ())))
+    return int(size) * np.dtype(leaf.dtype).itemsize
+
+
+def emit_bucket_gauges(plane: str, ranges, leaves, wire_dtype: str) -> None:
+    """Trace-time bucket telemetry (same contract as the exchange
+    gauges below: recorded once per compile, bytes/step = gauge x
+    steps): the live bucket count and each bucket's wire bytes."""
+    if not monitor.enabled():
+        return
+    monitor.set_gauge("bsp/exchange_buckets", len(ranges), plane=plane,
+                      dtype=wire_dtype)
+    for i, (lo, hi) in enumerate(ranges):
+        if wire_dtype == "bf16":
+            nbytes = 2 * sum(int(getattr(l, "size", 0))
+                             for l in leaves[lo:hi])
+        else:
+            nbytes = sum(_leaf_nbytes(l) for l in leaves[lo:hi])
+        monitor.set_gauge("bsp/exchange_bucket_bytes", nbytes,
+                          plane=plane, bucket=str(i), dtype=wire_dtype)
 
 # Reference strategy names -> TPU numeric strategy.
 _STRATEGY_ALIASES = {
@@ -106,6 +159,20 @@ class BSP_Exchanger:
         The residual rides ``TrainState.exchange_residual`` with a
         leading shard axis (parallel/bsp.py threads it).  Requires the
         bf16 wire dtype and ``exchange_what='grads'``.
+      exchange_buckets: partition the flatten-order gradient leaves
+        into this many layer-ordered, byte-balanced buckets
+        (``bucket_ranges``) and issue ONE collective per bucket
+        instead of per-leaf ops the compiler must re-combine.  On the
+        training step's grads path the collectives are embedded INTO
+        the backward DAG (``backward_exchange``: custom_vjp boundary
+        tags fire each bucket's psum the moment its layers' cotangents
+        are complete), so XLA's latency-hiding scheduler overlaps
+        bucket i's collective with bucket i+1's gradient compute — the
+        layer-ordered bucketing of arXiv:1802.06949 expressed in the
+        compiler's DAG.  ``1`` (default) keeps today's whole-tree
+        post-backward exchange byte-identical.  Numerics are identical
+        under any bucket count (pinned): bucketing regroups elementwise
+        collectives, it never reorders a per-element sum.
     """
 
     strategy: str = "psum"
@@ -115,8 +182,10 @@ class BSP_Exchanger:
     axis: str | tuple[str, ...] = AXIS_DATA
     exchange_dtype: str | None = None
     error_feedback: bool = False
+    exchange_buckets: int = 1
 
     def __post_init__(self):
+        validate_bucket_count(self.exchange_buckets)
         if self.strategy not in _STRATEGY_ALIASES:
             raise ValueError(
                 f"unknown exchange strategy {self.strategy!r}; "
@@ -178,6 +247,21 @@ class BSP_Exchanger:
                               what=self.exchange_what)
             monitor.inc("exchange/traces_total", strategy=self.resolved)
 
+        if self.exchange_buckets > 1:
+            # post-backward bucketed exchange (the grad-accum tail and
+            # the 'params' averaging mode; the single/multi grads path
+            # embeds the buckets into the backward via
+            # ``backward_exchange`` instead): one collective per
+            # byte-balanced leaf bucket
+            leaves, treedef = jax.tree.flatten(tree)
+            ranges = bucket_ranges([_leaf_nbytes(l) for l in leaves],
+                                   self.exchange_buckets)
+            emit_bucket_gauges("bsp", ranges, leaves, self.wire_dtype)
+            out = []
+            for lo, hi in ranges:
+                out.extend(self._reduce_bucket(tuple(leaves[lo:hi])))
+            return jax.tree.unflatten(treedef, out)
+
         if self.resolved == "psum_bf16":
             def reduce_leaf(x):
                 orig = x.dtype
@@ -221,6 +305,199 @@ class BSP_Exchanger:
         g = jax.lax.all_gather(y, axis)
         return jnp.sum(g.astype(jnp.float32), axis=0)
 
+    # -- bucketed exchange (ISSUE 13) -----------------------------------
+
+    @staticmethod
+    def _bucket_flat(cts: tuple):
+        """Concatenate a bucket's leaves into ONE vector when their
+        dtypes agree (one collective per bucket in the lowered
+        program — the reference's bucket flattening); ``None`` for a
+        mixed-dtype bucket (the per-leaf fallback keeps numerics
+        exact instead of forcing a cast)."""
+        if len({jnp.result_type(c) for c in cts}) != 1:
+            return None
+        if len(cts) == 1:
+            return cts[0].reshape(-1)
+        return jnp.concatenate([c.reshape(-1) for c in cts])
+
+    @staticmethod
+    def _split_like(flat, refs: tuple) -> tuple:
+        out, off = [], 0
+        for r in refs:
+            n = int(r.size)
+            out.append(flat[off:off + n].reshape(r.shape))
+            off += n
+        return tuple(out)
+
+    def _reduce_bucket(self, cts: tuple) -> tuple:
+        """Exchange one bucket of gradient leaves: elementwise-identical
+        to the per-leaf ``exchange`` (psum and the bf16 quantize/sum
+        are elementwise across shards — regrouping leaves cannot move
+        a single per-element sum), but issued as ONE collective."""
+        axis = self.axis
+        flat = self._bucket_flat(cts)
+        if flat is None:  # mixed dtypes: per-leaf ops, same boundary
+            if self.resolved == "psum_bf16":
+                red = tuple(
+                    (self._bf16_sum((c * self.fp16_scale)
+                                    .astype(jnp.bfloat16), axis)
+                     / self.fp16_scale).astype(c.dtype) for c in cts)
+            else:
+                red = jax.lax.psum(cts, axis)
+            if self.avg:
+                n = self._axis_size()
+                red = tuple(x / n for x in red)
+            return tuple(red)
+        if self.resolved == "psum_bf16":
+            y = (flat * self.fp16_scale).astype(jnp.bfloat16)
+            red = (self._bf16_sum(y, axis)
+                   / self.fp16_scale).astype(flat.dtype)
+        else:
+            red = jax.lax.psum(flat, axis)
+        if self.avg:
+            red = red / self._axis_size()
+        return self._split_like(red, cts)
+
+    def _reduce_bucket_ef(self, cts: tuple, res: tuple
+                          ) -> tuple[tuple, tuple]:
+        """Error-feedback variant of ``_reduce_bucket``: quantize
+        ``ct + residual`` to bf16, one all-gather + f32 sum for the
+        bucket, return (exchanged, new per-shard residual slice) —
+        the per-leaf ``exchange_with_residual`` math on one flat
+        bucket vector."""
+        axis = self.axis
+        flat = self._bucket_flat(cts)
+        if flat is None:
+            comp = tuple(c.astype(jnp.float32) + r
+                         for c, r in zip(cts, res))
+            q = tuple(c.astype(jnp.bfloat16) for c in comp)
+            new_r = tuple(c - qq.astype(jnp.float32)
+                          for c, qq in zip(comp, q))
+            out = tuple(self._bf16_sum(qq, axis).astype(c.dtype)
+                        for qq, c in zip(q, cts))
+            if self.avg:
+                n = self._axis_size()
+                out = tuple(x / n for x in out)
+            return out, new_r
+        rflat = self._bucket_flat(res)
+        comp = flat.astype(jnp.float32) + rflat
+        q = comp.astype(jnp.bfloat16)
+        new_r = comp - q.astype(jnp.float32)
+        out = self._bf16_sum(q, axis).astype(flat.dtype)
+        if self.avg:
+            out = out / self._axis_size()
+        return (self._split_like(out, cts),
+                self._split_like(new_r, res))
+
+    def _grad_tag(self):
+        """custom_vjp boundary marker for one bucket: identity forward;
+        the backward fires the bucket's collective the moment its
+        leaves' cotangents are complete, embedding the exchange into
+        the backward DAG for the latency-hiding scheduler to overlap
+        with the remaining segments' gradient compute."""
+
+        @jax.custom_vjp
+        def tag(leaves):
+            return leaves
+
+        def fwd(leaves):
+            return leaves, None
+
+        def bwd(_, cts):
+            return (self._reduce_bucket(cts),)
+
+        tag.defvjp(fwd, bwd)
+        return tag
+
+    def _ef_tag(self):
+        """Error-feedback boundary marker.  The residual slice is a
+        *differentiated* input whose "cotangent" we define to be the
+        NEW residual — the only side channel a backward segment has
+        for emitting state (a custom_vjp bwd returns exactly one
+        cotangent per input)."""
+
+        @jax.custom_vjp
+        def tag(leaves, res):
+            return leaves
+
+        def fwd(leaves, res):
+            return leaves, res
+
+        def bwd(res, cts):
+            out, new_r = self._reduce_bucket_ef(cts, res)
+            return out, new_r
+
+        tag.defvjp(fwd, bwd)
+        return tag
+
+    def backward_exchange(self, loss_fn, params: PyTree,
+                          model_state: PyTree, batch, rng,
+                          residual: PyTree | None = None):
+        """value_and_grad with the bucketed exchange embedded in the
+        backward DAG (the ``exchange_buckets > 1`` grads path).
+
+        The flatten-order leaves are cut into layer-ordered buckets
+        (``bucket_ranges``); each bucket's leaves pass through a
+        boundary tag whose custom backward issues that bucket's
+        collective as soon as all its cotangents exist.  Autodiff
+        runs the backward segment for the deepest layers first, so
+        the last bucket's psum is already on the interconnect while
+        earlier layers' cotangents are still being computed — the
+        lowered program carries B collectives interleaved with the
+        backward fusions instead of one trailing exchange block
+        (pinned structurally in tests/test_exchanger.py).
+
+        Returns ``(loss, (new_model_state, metrics), grads,
+        new_residual)`` where ``grads`` is ALREADY exchanged (and
+        averaged when ``avg``) and ``new_residual`` is ``None``
+        unless ``error_feedback``.
+        """
+        if self.exchange_what != "grads":
+            raise ValueError("backward_exchange embeds the GRADIENT "
+                             "exchange; exchange_what='params' has no "
+                             "backward to interleave with")
+        leaves, treedef = jax.tree.flatten(params)
+        ranges = bucket_ranges([_leaf_nbytes(l) for l in leaves],
+                               self.exchange_buckets)
+        emit_bucket_gauges("bsp", ranges, leaves, self.wire_dtype)
+        ef = self.error_feedback
+        if ef:
+            if residual is None:
+                raise ValueError("error_feedback needs the residual "
+                                 "tree (TrainState.exchange_residual)")
+            rleaves = jax.tree.flatten(residual)[0]
+
+        def tagged_loss(diff_arg, model_state, batch, rng):
+            buckets, rbuckets = (diff_arg if ef else (diff_arg, None))
+            new_leaves = []
+            for b in range(len(ranges)):
+                if ef:
+                    new_leaves.extend(
+                        self._ef_tag()(buckets[b], rbuckets[b]))
+                else:
+                    new_leaves.extend(self._grad_tag()(buckets[b]))
+            return loss_fn(jax.tree.unflatten(treedef, new_leaves),
+                           model_state, batch, rng)
+
+        buckets = tuple(tuple(leaves[lo:hi]) for lo, hi in ranges)
+        if ef:
+            rbuckets = tuple(tuple(rleaves[lo:hi]) for lo, hi in ranges)
+            diff_arg = (buckets, rbuckets)
+        else:
+            diff_arg = buckets
+        grad_fn = jax.value_and_grad(tagged_loss, has_aux=True)
+        (loss, (new_ms, metrics)), g = grad_fn(diff_arg, model_state,
+                                               batch, rng)
+        if ef:
+            gb, rb = g
+            new_residual = jax.tree.unflatten(
+                treedef, [r for rt in rb for r in rt])
+        else:
+            gb, new_residual = g, None
+        grads = jax.tree.unflatten(treedef,
+                                   [x for bt in gb for x in bt])
+        return loss, (new_ms, metrics), grads, new_residual
+
     def exchange_with_residual(self, tree: PyTree,
                                residual: PyTree) -> tuple[PyTree, PyTree]:
         """bf16 exchange with error feedback: quantize ``tree +
@@ -236,6 +513,24 @@ class BSP_Exchanger:
         if not self.error_feedback:
             raise ValueError("exchange_with_residual needs "
                              "error_feedback=True")
+
+        if self.exchange_buckets > 1:
+            # post-backward bucketed EF exchange (the grad-accum tail;
+            # per-bucket residual slices are the same leaves, just
+            # grouped): one all-gather per bucket
+            leaves, treedef = jax.tree.flatten(tree)
+            rleaves = jax.tree.flatten(residual)[0]
+            ranges = bucket_ranges([_leaf_nbytes(l) for l in leaves],
+                                   self.exchange_buckets)
+            emit_bucket_gauges("bsp", ranges, leaves, self.wire_dtype)
+            out, new_res = [], []
+            for lo, hi in ranges:
+                o, r = self._reduce_bucket_ef(
+                    tuple(leaves[lo:hi]), tuple(rleaves[lo:hi]))
+                out.extend(o)
+                new_res.extend(r)
+            return (jax.tree.unflatten(treedef, out),
+                    jax.tree.unflatten(treedef, new_res))
 
         # comp appears in both maps; XLA CSEs the duplicate add
         q_tree = jax.tree.map(

@@ -51,9 +51,65 @@ from theanompi_tpu.parallel.bsp import (
     apply_update,
     grad_and_metrics,
 )
+from theanompi_tpu.parallel.exchanger import (
+    _leaf_nbytes,
+    bucket_ranges,
+    emit_bucket_gauges,
+    validate_bucket_count,
+)
 from theanompi_tpu.parallel.mesh import AXIS_DATA
 
 PyTree = Any
+
+
+def _bucket_barrier_tag():
+    """Boundary marker for one gradient bucket under GSPMD: identity
+    forward; the backward wraps the bucket's cotangents in ONE
+    ``optimization_barrier``.  FSDP's reduce-scatters are
+    compiler-inserted (there is no program point to issue a hand
+    collective at — see make_bsp_fsdp_step's bf16 note), so bucketing
+    here is purely a SCHEDULING fence: the barrier keeps each
+    bucket's gradient collectives a unit the all-reduce combiner
+    cannot coalesce across, so the lowered program keeps per-bucket
+    collective groups interleaved with backward compute instead of
+    one merged trailing block.  Numerically the identity — pinned
+    bit-equal to the unbucketed step."""
+
+    @jax.custom_vjp
+    def tag(leaves):
+        return leaves
+
+    def fwd(leaves):
+        return leaves, None
+
+    def bwd(_, cts):
+        return (jax.lax.optimization_barrier(cts),)
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
+def _with_bucket_barriers(loss_fn, params_template: PyTree,
+                          exchange_buckets: int):
+    """Wrap ``loss_fn`` so every param bucket passes through a
+    boundary tag — shared by all three FSDP cadences (the accum scan
+    calls loss_fn per microbatch; wrapping at the builder keeps the
+    bucket structure in every backward)."""
+    t_leaves, _ = jax.tree.flatten(params_template)
+    ranges = bucket_ranges([_leaf_nbytes(l) for l in t_leaves],
+                           exchange_buckets)
+
+    def wrapped(params, model_state, batch, rng):
+        leaves, treedef = jax.tree.flatten(params)
+        emit_bucket_gauges("fsdp", ranges, leaves, "f32")
+        new_leaves = []
+        for lo, hi in ranges:
+            new_leaves.extend(_bucket_barrier_tag()(
+                tuple(leaves[lo:hi])))
+        return loss_fn(jax.tree.unflatten(treedef, new_leaves),
+                       model_state, batch, rng)
+
+    return wrapped
 
 
 def fsdp_specs(params: PyTree, mesh: jax.sharding.Mesh,
@@ -126,6 +182,7 @@ def make_bsp_fsdp_step(
     specs: PyTree | None = None,
     exchange_dtype: str = "f32",
     error_feedback: bool = False,
+    exchange_buckets: int = 1,
 ):
     """Build the FSDP training step (plus the stacked cadences).
 
@@ -161,6 +218,15 @@ def make_bsp_fsdp_step(
             "at full precision; exchange_dtype='bf16'/error_feedback "
             "have no seam here — use zero_sharding or plain BSP for "
             "the compressed exchange")
+    validate_bucket_count(exchange_buckets)
+    if exchange_buckets > 1:
+        # per-bucket optimization_barrier fences in the backward —
+        # GSPMD still owns the collectives (the bf16 note above), the
+        # fences only pin their per-bucket grouping.  Applied at the
+        # builder so every cadence (incl. the accum scan's
+        # per-microbatch backward) carries the bucket structure.
+        loss_fn = _with_bucket_barriers(loss_fn, params_template,
+                                        exchange_buckets)
     n = mesh.shape[AXIS_DATA]
     # one placement contract: callers that already derived specs (the
     # model layer stores them as param_specs for checkpoint-resume

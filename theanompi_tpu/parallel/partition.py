@@ -1,0 +1,65 @@
+"""Byte-balanced contiguous range partitioning — the ONE greedy walk
+behind every plan that must be derived identically on every rank.
+
+Two consumers, one algorithm:
+
+* ``parallel/shards.py partition_ranges`` — the sharded parameter
+  service cuts the center tree's leaves into K shard ranges (ISSUE 8);
+  validates ``k <= n`` because a shard with no leaves has nothing to
+  serve.
+* ``parallel/exchanger.py`` — the bucketed gradient exchange cuts the
+  flatten-order gradient leaves into layer-ordered exchange buckets
+  (ISSUE 13); clamps ``k`` to ``n`` because a bucket plan over fewer
+  leaves than buckets should just degrade to per-leaf buckets.
+
+The plan is a pure function of (sizes, k): deterministic, no RNG, no
+host state — every client/rank recomputes it from its own copy of the
+model tree and lands on the identical cut, so no plan ever travels
+over a wire.  Keeping the walk here (instead of two copies) is what
+makes that guarantee auditable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def balanced_ranges(sizes: Sequence[int], k: int) -> list[tuple[int, int]]:
+    """Cut ``len(sizes)`` items into ``k`` contiguous ``(lo, hi)``
+    ranges balanced by total size.
+
+    Greedy walk: each range takes items while that brings its
+    cumulative total closer to the i-th size quantile, always taking
+    at least one item and leaving at least one for every range after
+    it.  Requires ``1 <= k <= len(sizes)``; callers that want
+    clamping (bucket plans) clamp before calling.
+    """
+    sizes = [int(s) for s in sizes]
+    n, k = len(sizes), int(k)
+    if k < 1:
+        raise ValueError(f"need k >= 1 ranges, got {k}")
+    if n == 0:
+        raise ValueError("cannot partition an empty sequence")
+    if k > n:
+        raise ValueError(
+            f"{k} ranges over {n} items — items are never split, so "
+            "at most one range per item")
+    total = sum(sizes)
+    ranges: list[tuple[int, int]] = []
+    lo, acc = 0, 0
+    for i in range(k):
+        hi = lo + 1
+        acc += sizes[lo]
+        cap = n - (k - i - 1)  # leave >= 1 item per remaining range
+        target = total * (i + 1) / k
+        while hi < cap:
+            nxt = acc + sizes[hi]
+            if abs(nxt - target) <= abs(acc - target):
+                acc = nxt
+                hi += 1
+            else:
+                break
+        ranges.append((lo, hi))
+        lo = hi
+    assert lo == n, (ranges, n)
+    return ranges

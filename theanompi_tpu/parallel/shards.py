@@ -69,6 +69,7 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
+from theanompi_tpu.parallel.partition import balanced_ranges
 from theanompi_tpu.parallel.service import (
     FenceBusy,
     ParamService,
@@ -107,12 +108,12 @@ def partition_ranges(sizes: Sequence[int], n_shards: int
     ``(lo, hi)`` ranges balanced by total bytes.
 
     Deterministic in (sizes, n_shards) — every client derives the same
-    plan from its own copy of the model tree.  Greedy walk: each shard
-    takes leaves while that brings its cumulative total closer to the
-    i-th byte quantile, always taking at least one leaf and leaving at
-    least one for every shard after it."""
-    sizes = [int(s) for s in sizes]
-    n, k = len(sizes), int(n_shards)
+    plan from its own copy of the model tree.  The greedy quantile
+    walk lives in ``parallel/partition.py`` (shared with the bucketed
+    gradient exchange, which derives its layer-ordered bucket plan
+    from the same function — one algorithm, one audit surface); this
+    wrapper keeps the shard-fleet error messages."""
+    k, n = int(n_shards), len(sizes)
     if k < 1:
         raise ValueError(f"n_shards must be >= 1, got {k}")
     if n == 0:
@@ -121,25 +122,7 @@ def partition_ranges(sizes: Sequence[int], n_shards: int
         raise ValueError(
             f"{k} shards over {n} leaves — a leaf is never split, so "
             "at most one shard per leaf (lower --shards)")
-    total = sum(sizes)
-    ranges: list[tuple[int, int]] = []
-    lo, acc = 0, 0
-    for i in range(k):
-        hi = lo + 1
-        acc += sizes[lo]
-        cap = n - (k - i - 1)  # leave >= 1 leaf per remaining shard
-        target = total * (i + 1) / k
-        while hi < cap:
-            nxt = acc + sizes[hi]
-            if abs(nxt - target) <= abs(acc - target):
-                acc = nxt
-                hi += 1
-            else:
-                break
-        ranges.append((lo, hi))
-        lo = hi
-    assert lo == n, (ranges, n)
-    return ranges
+    return balanced_ranges(sizes, k)
 
 
 def shard_addresses(server_addr: str | None) -> list[str] | None:

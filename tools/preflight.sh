@@ -333,6 +333,20 @@ PYEOF
   EXCHANGE_RC=$?
   rm -rf "$EXCHDIR"
   echo "exchange smoke rc=$EXCHANGE_RC"
+  echo "## bucketed-exchange smoke (B=4 in-step bucketing on the 8-dev CPU mesh, docs/DESIGN.md 'Bucketed exchange')"
+  # the ISSUE 13 vertical: bucketed exchange programs over the
+  # ResNet-50-sized tree on the 8-device CPU mesh.  The gate asserts
+  # (a) a real B=4 train step is BIT-identical to B=1 over 3
+  # iterations (bucketing is scheduling, never numerics) and (b) the
+  # bsp/exchange_buckets gauge landed in the monitor JSONL
+  # (tools/bench_exchange.py --buckets 4 --smoke, exit 1 on any miss)
+  BUCKETDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$BUCKETDIR" \
+    python tools/bench_exchange.py --buckets 4 --smoke \
+      --out "$BUCKETDIR/BENCH_bucketed_smoke.json"
+  BUCKET_RC=$?
+  rm -rf "$BUCKETDIR"
+  echo "bucketed-exchange smoke rc=$BUCKET_RC"
   echo "## shard smoke (2-shard EASGD over real sockets + kill-recovery, docs/DESIGN.md 'Sharded parameter service')"
   # the sharded-center vertical end-to-end: two REAL shard processes,
   # the router's concurrent leaf-range exchanges, and the fault leg —
@@ -384,7 +398,7 @@ PYEOF
   RPC_RC=$?
   rm -rf "$RPCDIR"
   echo "rpc smoke rc=$RPC_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$BUCKET_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"

@@ -50,6 +50,18 @@ def main() -> int:
                     "shows 1.4 ms/step of MSA prefetch stalls and "
                     "conv fusions at 93%% of HBM roofline; more scoped "
                     "VMEM is the public lever for both)")
+    ap.add_argument("--buckets", type=int, default=1,
+                    help="ModelConfig.exchange_buckets — the ISSUE 13 "
+                    "bucketed backward/exchange interleaving lever "
+                    "(run with the latency-hiding scheduler flag: the "
+                    "per-bucket collectives only overlap backward "
+                    "compute when the scheduler is allowed to move "
+                    "them)")
+    ap.add_argument("--trace", default=None,
+                    help="dump a jax.profiler trace of 3 steady-state "
+                    "dispatches to this dir (the bucketed A/B pair "
+                    "profiles through the SAME k-cadence harness the "
+                    "ladder times)")
     args = ap.parse_args()
     if args.xla_flags:
         os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -78,6 +90,7 @@ def main() -> int:
     cfg = ModelConfig(batch_size=args.batch, compute_dtype="bfloat16",
                       steps_per_call=args.k, resnet_stem=args.stem,
                       track_top5=False, print_freq=10**9,
+                      exchange_buckets=args.buckets,
                       # this harness replays ONE staged batch through
                       # every dispatch; donation would delete it after
                       # the first (bench.py has the same opt-out)
@@ -113,6 +126,12 @@ def main() -> int:
     dt = time.perf_counter() - t0
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            for _ in range(3):
+                state, metrics = step_fn(state, staged, rng)
+            fenced_loss(metrics)
+
     per_chip = n_disp * args.k * global_batch / dt / n_chips
     print(json.dumps({
         # a shrunken-crop wiring check must never enter the ladder
@@ -122,6 +141,7 @@ def main() -> int:
         "steps_per_call": args.k,
         "batch_per_chip": args.batch,
         "stem": args.stem,
+        "exchange_buckets": args.buckets,
         "img_per_sec_per_chip": round(per_chip, 2),
         "step_ms": round(dt / (n_disp * args.k) * 1e3, 2),
         "dispatch_ms": round(dt / n_disp * 1e3, 2),
