@@ -171,6 +171,25 @@ def test_parallel_degree_flags():
                  "--model-parallel", "2"])
 
 
+def test_local_aggregation_refusal_matrix():
+    """--local-aggregation follows the --shards refusal matrix: GOSGD
+    (whole-tree gossip, nothing to delta-sum) and BSP (in-step XLA
+    collectives) refuse with a typed SystemExit instead of silently
+    training at full wire cost."""
+    from theanompi_tpu.launcher import tmlocal
+
+    for rule in ("GOSGD", "BSP"):
+        with pytest.raises(SystemExit,
+                           match="local-aggregation applies to"):
+            tmlocal([rule, "-m", "tests._tiny_models", "-c",
+                     "TinyCifar", "--local-aggregation"])
+    # EASGD/ASGD accept the flag (parse-level: it lands in kwargs)
+    p = _build_parser(multihost=False)
+    args = p.parse_args(["EASGD", "--local-aggregation"])
+    assert args.local_aggregation is True
+    assert p.parse_args(["ASGD"]).local_aggregation is False
+
+
 @pytest.mark.slow
 def test_tmlocal_tp_end_to_end(tmp_path, capsys):
     """tmlocal BSP --model-parallel: the TP model trains over a

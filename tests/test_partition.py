@@ -1,0 +1,115 @@
+"""Property tests for the shared range-partition walk
+(``parallel/partition.py``) on DEGENERATE inputs — single-leaf trees,
+zero-byte leaves, and plans wider than the leaf count — exercised
+through BOTH consumers: the shard plane (``partition_ranges``, refuses
+k > n) and the bucket plane (``bucket_ranges``, clamps).  The walk is
+the one algorithm every rank must derive identically, so the
+properties (cover, contiguous, non-empty, deterministic) are asserted
+over a brute-force sweep rather than a few samples.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel.exchanger import bucket_ranges
+from theanompi_tpu.parallel.partition import balanced_ranges
+from theanompi_tpu.parallel.shards import partition_ranges
+
+
+def assert_valid_plan(ranges, n, k):
+    assert len(ranges) == k
+    assert ranges[0][0] == 0 and ranges[-1][1] == n
+    for (_, b), (c, _) in zip(ranges, ranges[1:]):
+        assert b == c                       # contiguous
+    assert all(hi > lo for lo, hi in ranges)  # never empty
+
+
+class TestBalancedRangesProperties:
+    def test_property_sweep_random_sizes(self):
+        """Brute-force property sweep: every (sizes, k) plan covers,
+        is contiguous, non-empty, and deterministic — including sizes
+        drawn with many zeros (zero-byte leaves are legal: empty
+        buffers still need an owner)."""
+        rng = np.random.default_rng(11)
+        for trial in range(60):
+            n = int(rng.integers(1, 40))
+            # ~1/3 zero-byte leaves on average
+            sizes = [int(s) if rng.random() > 0.33 else 0
+                     for s in rng.integers(1, 10_000, n)]
+            for k in {kk for kk in (1, 2, n // 2 or 1, n) if kk <= n}:
+                plan = balanced_ranges(sizes, k)
+                assert_valid_plan(plan, n, k)
+                assert plan == balanced_ranges(list(sizes), k)
+
+    def test_single_leaf(self):
+        assert balanced_ranges([123], 1) == [(0, 1)]
+        assert partition_ranges([123], 1) == [(0, 1)]
+        assert bucket_ranges([123], 1) == [(0, 1)]
+
+    def test_all_zero_byte_leaves(self):
+        """A tree of empty buffers still partitions: every range owns
+        >= 1 leaf and the cover holds (total bytes 0 makes every
+        quantile target 0 — the walk must not divide by it or stall)."""
+        for n in (1, 2, 3, 7):
+            for k in range(1, n + 1):
+                plan = balanced_ranges([0] * n, k)
+                assert_valid_plan(plan, n, k)
+
+    def test_zero_byte_leaves_between_giants(self):
+        sizes = [0, 10**9, 0, 0, 10**9, 0]
+        for k in (1, 2, 3, 6):
+            plan = balanced_ranges(sizes, k)
+            assert_valid_plan(plan, len(sizes), k)
+        # the two giants must not share a range when k >= 2
+        by_range = [sum(sizes[lo:hi]) for lo, hi in
+                    balanced_ranges(sizes, 2)]
+        assert by_range == [10**9, 10**9]
+
+    def test_k_above_leaf_count_raises(self):
+        with pytest.raises(ValueError, match="never split"):
+            balanced_ranges([1, 2, 3], 4)
+
+    def test_k_below_one_raises(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            balanced_ranges([1], 0)
+
+    def test_empty_sequence_raises(self):
+        with pytest.raises(ValueError, match="empty"):
+            balanced_ranges([], 1)
+
+
+class TestConsumerPlanes:
+    """The two consumers must keep their DOCUMENTED degenerate-input
+    contracts: shards refuse a plan wider than the tree (a shard with
+    no leaves has nothing to serve), buckets clamp (a bucket plan is a
+    scheduling hint, not an ownership contract)."""
+
+    def test_shard_plane_refuses_k_above_leaves(self):
+        with pytest.raises(ValueError, match="lower --shards"):
+            partition_ranges([8, 8], 3)
+
+    def test_shard_plane_refuses_empty_tree(self):
+        with pytest.raises(ValueError, match="empty"):
+            partition_ranges([], 1)
+
+    def test_bucket_plane_clamps_to_per_leaf(self):
+        plan = bucket_ranges([4, 4, 4], 100)
+        assert plan == [(0, 1), (1, 2), (2, 3)]
+
+    def test_bucket_plane_single_leaf_any_count(self):
+        for b in (1, 2, 17):
+            assert bucket_ranges([64], b) == [(0, 1)]
+
+    def test_planes_agree_when_both_legal(self):
+        """One walk, two wrappers: wherever both consumers accept
+        (k <= n), their plans are identical — the shared-algorithm
+        guarantee the module docstring promises."""
+        rng = np.random.default_rng(13)
+        for _ in range(20):
+            n = int(rng.integers(1, 30))
+            sizes = [int(s) for s in rng.integers(0, 5_000, n)]
+            for k in range(1, n + 1):
+                assert partition_ranges(sizes, k) \
+                    == bucket_ranges(sizes, k)

@@ -32,12 +32,12 @@ Mechanics:
   streams at full socket rate.  The in-flight window doubles as the
   trainer-side backpressure: a slow consumer freezes the window,
   which idles the fleet — no queue anywhere grows past ``depth``.
-* **one socket per reader peer (optional)** — ``mux=True`` (env
-  ``THEANOMPI_TPU_INGEST_MUX=1``) rides the RPC substrate's stream
-  multiplexing (``parallel/rpc.py``): the meta/probe control clients
-  and the pull pipeline to one reader share one authenticated socket;
-  against a non-mux server every stream silently falls back to its
-  own socket.
+* **one socket per reader peer (default ON)** — ``mux=True`` (opt out
+  with env ``THEANOMPI_TPU_INGEST_MUX=0``) rides the RPC substrate's
+  stream multiplexing (``parallel/rpc.py``): the meta/probe control
+  clients and the pull pipeline to one reader share one authenticated
+  socket; against a non-mux server every stream silently falls back
+  to its own socket, which is what makes the default safe.
 * **overload** — a reader's typed ``Overloaded`` rejection reschedules
   the pull after a short jittered backoff (kept small: a backed-off
   index can be the stream's head-of-line, and everything behind the
@@ -186,9 +186,16 @@ class RemoteBatchSource:
         #: one multiplexed socket per reader peer (parallel/rpc.py):
         #: the meta/probe control clients and the pull pipeline share
         #: it, and against a non-mux server every stream silently gets
-        #: its own socket — so this is safe to leave on either way
-        self._mux = (mux if mux is not None else os.environ.get(
-            "THEANOMPI_TPU_INGEST_MUX", "0") == "1")
+        #: its own socket — so this is safe to leave on either way.
+        #: ON by default (THEANOMPI_TPU_INGEST_MUX=0 opts out) since
+        #: the bench_rpc --soak byte-identity pins hold under load.
+        #: A v1-pinned run keeps dedicated sockets — mux streams are
+        #: wire-v2 framed by construction, so honoring the operator's
+        #: v1 escape hatch means never negotiating a mux hello
+        self._mux = (mux if mux is not None else (
+            os.environ.get("THEANOMPI_TPU_INGEST_MUX", "1") == "1"
+            and os.environ.get("THEANOMPI_TPU_WIRE_PROTOCOL", "v2")
+            != "v1"))
         #: addr -> rpc.MuxConnection; fetch thread + constructor only
         self._transports: dict = {}
 

@@ -119,6 +119,18 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                         "exchange on a dedicated thread so compute "
                         "overlaps the RPC (bounded staleness 1; "
                         "docs/DESIGN.md 'Overlapped exchange')")
+    p.add_argument("--local-aggregation", action="store_true",
+                   help="EASGD/ASGD: aggregate this host's worker "
+                        "exchanges in-process so N local workers cost "
+                        "ONE wire exchange per shard per period — ASGD "
+                        "delta-sums the gradient pushes, EASGD "
+                        "composes the elastic displacements against "
+                        "one center version (docs/DESIGN.md "
+                        "'Hierarchical exchange').  Workers fall back "
+                        "to direct exchange if the aggregation plane "
+                        "goes down; composes with --overlap-exchange "
+                        "(the aggregate rides the exchange threads) "
+                        "and --shards/--server-addr fleets")
     p.add_argument("--wire-protocol", default=None,
                    choices=("v1", "v2"),
                    help="param-service transport: v2 framed zero-copy "
@@ -466,6 +478,16 @@ def _run(args, multihost: bool) -> int:
         # — silently ignoring the flag would let the user believe the
         # exchange is overlapped when it is not
         raise SystemExit("--overlap-exchange applies to EASGD/ASGD only")
+    if args.local_aggregation and args.rule not in ("EASGD", "ASGD"):
+        # same refusal matrix as --shards: GOSGD ships whole trees to
+        # random peers (nothing to delta-sum) and BSP exchanges inside
+        # the step program — silently ignoring the flag would let the
+        # user believe the wire cost dropped when it did not
+        raise SystemExit(
+            "--local-aggregation applies to EASGD/ASGD only: GOSGD "
+            "gossip pushes whole (params, weight) trees to random "
+            "peers and BSP exchanges in-step via XLA collectives "
+            "(docs/DESIGN.md 'Hierarchical exchange')")
     shard_group = None
     if args.shards is not None:
         if args.rule not in ("EASGD", "ASGD"):
@@ -508,6 +530,8 @@ def _run(args, multihost: bool) -> int:
                 kwargs.update(session_id=args.session_id)
         if args.overlap_exchange:
             kwargs.update(overlap=True)
+        if args.local_aggregation:
+            kwargs.update(local_aggregation=True)
         if args.max_restarts:
             # worker-thread supervision (resilience.supervisor) — the
             # first line of defense; the session-level auto-resume

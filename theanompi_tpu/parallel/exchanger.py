@@ -584,6 +584,19 @@ def easgd_both_updates(worker: PyTree, center: PyTree, alpha):
     return new_w, new_c
 
 
+@jax.jit
+def easgd_center_update_n(center: PyTree, worker_mean: PyTree,
+                          alpha_eff) -> PyTree:
+    """Aggregated center move (hierarchical exchange,
+    ``parallel/aggregate.py``): ``center + alpha_eff*(mean - center)``
+    with ``alpha_eff = n*alpha`` — the closed-form composition of n
+    same-version elastic exchanges.  Deliberately NON-donating: the
+    caller returns the pre-update ``center`` to the aggregator, which
+    computes each worker's own elastic pull against it."""
+    return jax.tree.map(lambda c, m: c + alpha_eff * (m - c),
+                        center, worker_mean)
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def easgd_apply_delta(current: PyTree, snapshot: PyTree,
                       returned: PyTree) -> PyTree:

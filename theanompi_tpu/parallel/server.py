@@ -32,7 +32,10 @@ import jax
 import numpy as np
 import optax
 
-from theanompi_tpu.parallel.exchanger import easgd_both_updates
+from theanompi_tpu.parallel.exchanger import (
+    easgd_both_updates,
+    easgd_center_update_n,
+)
 from theanompi_tpu.resilience import faults
 
 PyTree = Any
@@ -79,6 +82,36 @@ class EASGDServer:
             self._center = new_c  # lazily fetched by the next exchange
             self.n_exchanges += 1
         return new_w
+
+    def exchange_n(self, worker_mean: PyTree, n: int) -> PyTree:
+        """Aggregated elastic exchange (the hierarchical plane,
+        ``parallel/aggregate.py``): ``worker_mean`` is the mean of
+        ``n`` co-located workers' params, and the center applies the
+        closed-form composition of n independent exchanges against ONE
+        center version::
+
+            center += n * alpha * (mean - center)
+                   == center + alpha * sum_i (w_i - center)
+
+        Returns the PRE-update center: each worker's own elastic pull
+        ``w_i - alpha*(w_i - center)`` uses that same version, so the
+        workers compute their returns host-side (each on its own
+        thread) and the wire carries ONE tree each way instead of n.  Stability note
+        (docs/DESIGN.md "Hierarchical exchange"): the composed center
+        move is ``n*alpha`` — operators pick alpha so ``n*alpha <= 1``,
+        the EASGD paper's ``beta = N*alpha`` parameterization."""
+        faults.fire("exchange", kind="easgd")
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"exchange_n needs n >= 1, got {n}")
+        with self._lock:
+            center = self._center
+            if not _is_host(center):
+                center = jax.device_get(center)
+            self._center = easgd_center_update_n(center, worker_mean,
+                                                 n * self.alpha)
+            self.n_exchanges += n
+        return center
 
     def get_center(self) -> PyTree:
         with self._lock:
@@ -127,6 +160,29 @@ class ASGDServer:
             self._center, self._opt_state = self._apply(
                 self._center, self._opt_state, host_grads)
             self.n_updates += 1
+            center = self._center
+        return jax.device_get(center)
+
+    def push_pull_n(self, grad_sum: PyTree, n: int) -> PyTree:
+        """Aggregated grad push (the hierarchical plane,
+        ``parallel/aggregate.py``): ``grad_sum`` is the SUM of ``n``
+        co-located workers' gradients, applied as ONE optimizer step —
+        the delta-sum of n same-version pushes (exact for any
+        gradient-linear update; for stateful optimizers this is the
+        standard large-batch composition, docs/DESIGN.md "Hierarchical
+        exchange").  ``n`` rides along so the update count — and the
+        shard plane's version accounting — reflect the n logical
+        pushes.  Returns the fresh center, fanned back to all n
+        workers by the aggregator."""
+        faults.fire("exchange", kind="asgd")
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"push_pull_n needs n >= 1, got {n}")
+        host_grads = jax.device_get(grad_sum)
+        with self._lock:
+            self._center, self._opt_state = self._apply(
+                self._center, self._opt_state, host_grads)
+            self.n_updates += n
             center = self._center
         return jax.device_get(center)
 

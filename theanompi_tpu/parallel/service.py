@@ -277,10 +277,14 @@ class ParamService:
         sid, *rest = args
         if op == "easgd_exchange":
             return _np(self._store("easgd", sid).exchange(*rest))
+        if op == "easgd_exchange_n":
+            return _np(self._store("easgd", sid).exchange_n(*rest))
         if op == "easgd_get_center":
             return _np(self._store("easgd", sid).get_center())
         if op == "asgd_push_pull":
             return _np(self._store("asgd", sid).push_pull(*rest))
+        if op == "asgd_push_pull_n":
+            return _np(self._store("asgd", sid).push_pull_n(*rest))
         if op == "asgd_set_lr":
             return self._store("asgd", sid).set_lr(*rest)
         if op == "asgd_get_center":
@@ -297,7 +301,8 @@ class ParamService:
 
     #: ops that carry (session_id, *args) — validated before unpacking
     SESSION_OPS = frozenset({
-        "easgd_exchange", "easgd_get_center", "asgd_push_pull",
+        "easgd_exchange", "easgd_exchange_n", "easgd_get_center",
+        "asgd_push_pull", "asgd_push_pull_n",
         "asgd_set_lr", "asgd_get_center", "asgd_get_opt_state",
         "gosgd_push", "gosgd_drain", "gosgd_deactivate",
     })
@@ -1060,6 +1065,16 @@ class RemoteEASGD(ServiceClient):
         self._rebuild = out
         return out
 
+    def exchange_n(self, worker_mean: PyTree, n: int) -> PyTree:
+        """Aggregated exchange (parallel/aggregate.py): one wire round
+        trip for n co-located workers; returns the PRE-update center
+        (see ``EASGDServer.exchange_n``) — a legitimate rebuild
+        payload, so a post-aggregate rejoin re-seeds from it."""
+        out = self.call("easgd_exchange_n", self._sid,
+                        _np(jax.device_get(worker_mean)), int(n))
+        self._rebuild = out
+        return out
+
     def get_center(self) -> PyTree:
         return self.call("easgd_get_center", self._sid)
 
@@ -1102,6 +1117,15 @@ class RemoteASGD(ServiceClient):
         self._rebuild = out
         return out
 
+    def push_pull_n(self, grad_sum: PyTree, n: int) -> PyTree:
+        """Aggregated grad push (parallel/aggregate.py): the delta-sum
+        of n co-located workers' pushes in one wire round trip; the
+        reply is the fresh center (see ``ASGDServer.push_pull_n``)."""
+        out = self.call("asgd_push_pull_n", self._sid,
+                        _np(jax.device_get(grad_sum)), int(n))
+        self._rebuild = out
+        return out
+
     def set_lr(self, lr: float) -> None:
         self.call("asgd_set_lr", self._sid, float(lr))
 
@@ -1123,8 +1147,8 @@ class RemoteGossipHub(ServiceClient):
     payload-free so every client may send it)."""
 
     def __init__(self, address: str, n_workers: int, rank_offset: int = 0,
-                 session_id: str = "default"):
-        super().__init__(address)
+                 session_id: str = "default", transport=None):
+        super().__init__(address, transport=transport)
         self._sid = str(session_id)
         self.n_workers = n_workers
         self.rank_offset = rank_offset

@@ -220,16 +220,21 @@ class ShardParamService(ParamService):
             self._inflight[kind] = self._inflight.get(kind, 0) + 1
 
     def _settle(self, kind: str, client_id: str | None = None,
-                seq: int | None = None) -> None:
+                seq: int | None = None, count: int = 1) -> None:
         """Retire an in-flight mutation; on success record it in the
         vector clock (per-client max — an at-least-once duplicate of a
-        lost-reply re-send must not read as a NEW exchange)."""
+        lost-reply re-send must not read as a NEW exchange).  ``count``
+        is the aggregate op's worker-count multiplier: one hierarchical
+        exchange stands for ``count`` same-version worker exchanges,
+        and the applied counter must say so — the fence's accounting
+        stays identical to ``count`` independent exchanges."""
         with self._gate_cv:
             self._inflight[kind] = self._inflight.get(kind, 1) - 1
             if client_id is not None:
                 vc = self._vclock.setdefault(kind, {})
                 vc[client_id] = max(int(seq), vc.get(client_id, 0))
-                self._applied[kind] = self._applied.get(kind, 0) + 1
+                self._applied[kind] = self._applied.get(kind, 0) \
+                    + int(count)
             self._gate_cv.notify_all()
 
     def _freeze(self, kind: str, session_id: str, token: str) -> dict:
@@ -281,11 +286,11 @@ class ShardParamService(ParamService):
     def handle(self, op: str, *args):
         base = self.MUT_OPS.get(op)
         if base is not None:
-            if len(args) != 4 or not isinstance(args[0], str):
+            if len(args) not in (4, 5) or not isinstance(args[0], str):
                 raise ValueError(
                     f"{op} requires (session_id, payload, client_id, "
-                    f"seq) — got {len(args)} args")
-            sid, payload, client_id, seq = args
+                    f"seq[, n_workers]) — got {len(args)} args")
+            sid, payload, client_id, seq = args[:4]
             try:
                 # validate BEFORE the store op: a mutation that applied
                 # but could not be versioned would be invisible to the
@@ -294,14 +299,34 @@ class ShardParamService(ParamService):
             except (TypeError, ValueError):
                 raise ValueError(
                     f"{op} seq must be an int, got {seq!r}") from None
+            # optional 5th arg: the hierarchical plane's worker-count
+            # multiplier (parallel/aggregate.py) — the SAME tagged op,
+            # dispatched to the aggregate store math, counted in the
+            # fence accounting as n_workers same-version exchanges
+            n_workers = None
+            if len(args) == 5:
+                try:
+                    n_workers = int(args[4])
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"{op} n_workers must be an int, "
+                        f"got {args[4]!r}") from None
+                if n_workers < 1:
+                    raise ValueError(
+                        f"{op} n_workers must be >= 1, got {n_workers}")
             kind = base.split("_", 1)[0]
             self._admit(kind)
             try:
-                out = super().handle(base, sid, payload)
+                if n_workers is None:
+                    out = super().handle(base, sid, payload)
+                else:
+                    out = super().handle(base + "_n", sid, payload,
+                                         n_workers)
             except BaseException:
                 self._settle(kind)  # failed mutations don't version
                 raise
-            self._settle(kind, str(client_id), seq)
+            self._settle(kind, str(client_id), seq,
+                         count=1 if n_workers is None else n_workers)
             return out
         if op == "shard_freeze":
             return self._freeze(*args)
@@ -330,15 +355,21 @@ def serve_shard(host: str = "0.0.0.0", port: int = 0,
 
 
 def _shard_transports(addresses: Sequence[str]) -> list | None:
-    """One multiplexed transport per shard peer
-    (``THEANOMPI_TPU_SHARD_MUX=1``): the shard's session client and
-    its fence control client become two streams on ONE socket —
-    halving the router's fd count — which the selector loop's
+    """One multiplexed transport per shard peer: the shard's session
+    client and its fence control client become two streams on ONE
+    socket — halving the router's fd count — which the selector loop's
     control-pool routing of ``shard_freeze``/``shard_release`` makes
-    deadlock-free (see ``ShardedServiceClient``).  Off by default;
-    against a non-mux server the transports silently degrade to
-    dedicated sockets."""
-    if os.environ.get("THEANOMPI_TPU_SHARD_MUX", "0") != "1":
+    deadlock-free (see ``ShardedServiceClient``).  ON by default
+    (``THEANOMPI_TPU_SHARD_MUX=0`` opts out) since the ``bench_rpc
+    --soak`` byte-identity pins hold under sustained load; against a
+    non-mux server the transports silently degrade to dedicated
+    sockets, so the default is safe either way."""
+    if os.environ.get("THEANOMPI_TPU_SHARD_MUX", "1") != "1":
+        return None
+    if os.environ.get("THEANOMPI_TPU_WIRE_PROTOCOL", "v2") == "v1":
+        # mux streams are wire-v2 framed by construction; a client
+        # pinned to v1 pickle keeps its dedicated sockets — the same
+        # silent degradation as a non-mux server
         return None
     from theanompi_tpu.parallel.rpc import MuxConnection
 
@@ -353,9 +384,17 @@ class _ShardEASGD(RemoteEASGD):
     sub-result."""
 
     def exchange_tagged(self, sub_leaves: list, client_id: str,
-                        seq: int) -> list:
-        out = self.call("shard_exchange", self._sid, sub_leaves,
-                        client_id, int(seq))
+                        seq: int, n_workers: int | None = None) -> list:
+        """``n_workers`` marks an AGGREGATE sub-exchange (the
+        hierarchical plane): same tagged op, a 5th multiplier arg, and
+        the reply is this shard's PRE-update center range instead of
+        the new worker range."""
+        if n_workers is None:
+            out = self.call("shard_exchange", self._sid, sub_leaves,
+                            client_id, int(seq))
+        else:
+            out = self.call("shard_exchange", self._sid, sub_leaves,
+                            client_id, int(seq), int(n_workers))
         self._rebuild = out
         return out
 
@@ -368,9 +407,16 @@ class _ShardASGD(RemoteASGD):
     """One shard's ASGD session client (see :class:`_ShardEASGD`)."""
 
     def push_pull_tagged(self, sub_grads: list, client_id: str,
-                         seq: int) -> list:
-        out = self.call("shard_push_pull", self._sid, sub_grads,
-                        client_id, int(seq))
+                         seq: int, n_workers: int | None = None) -> list:
+        """``n_workers`` marks an AGGREGATE sub-push (see
+        ``_ShardEASGD.exchange_tagged``); the reply stays the fresh
+        center range either way."""
+        if n_workers is None:
+            out = self.call("shard_push_pull", self._sid, sub_grads,
+                            client_id, int(seq))
+        else:
+            out = self.call("shard_push_pull", self._sid, sub_grads,
+                            client_id, int(seq), int(n_workers))
         self._rebuild = out
         return out
 
@@ -442,6 +488,20 @@ class ShardedEASGD(ShardedServiceClient):
             for c, sub in zip(self._shard_clients, subs)]
         return self._plan.join(self._scatter(thunks))
 
+    def exchange_n(self, worker_mean: PyTree, n: int) -> PyTree:
+        """Aggregated exchange over the fleet: ONE tagged sub-exchange
+        per shard carries the n-worker mean + multiplier; the
+        reassembled reply is the PRE-update center (see
+        ``EASGDServer.exchange_n``) the aggregator fans back out."""
+        subs = self._plan.split(worker_mean)
+        seq = self._next_seq()
+        cid = self._client_id
+        n = int(n)
+        thunks = [
+            (lambda c=c, sub=sub: c.exchange_tagged(sub, cid, seq, n))
+            for c, sub in zip(self._shard_clients, subs)]
+        return self._plan.join(self._scatter(thunks))
+
     def fenced_center(self) -> tuple[PyTree, dict]:
         """The consistent cut + the vector clock it froze at (the
         'single global version' the checkpoint corresponds to)."""
@@ -506,6 +566,19 @@ class ShardedASGD(ShardedServiceClient):
         cid = self._client_id
         thunks = [
             (lambda c=c, sub=sub: c.push_pull_tagged(sub, cid, seq))
+            for c, sub in zip(self._shard_clients, subs)]
+        return self._plan.join(self._scatter(thunks))
+
+    def push_pull_n(self, grad_sum: PyTree, n: int) -> PyTree:
+        """Aggregated grad push over the fleet (see
+        ``ShardedEASGD.exchange_n``): one tagged sub-push per shard,
+        reassembling the fresh center."""
+        subs = self._plan.split(grad_sum)
+        seq = self._next_seq()
+        cid = self._client_id
+        n = int(n)
+        thunks = [
+            (lambda c=c, sub=sub: c.push_pull_tagged(sub, cid, seq, n))
             for c, sub in zip(self._shard_clients, subs)]
         return self._plan.join(self._scatter(thunks))
 

@@ -44,6 +44,10 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.models.base import TpuModel
+from theanompi_tpu.parallel.aggregate import (
+    AggregatedExchange,
+    LocalAggregator,
+)
 from theanompi_tpu.parallel.exchanger import (
     easgd_apply_delta,
     gosgd_merge,
@@ -169,6 +173,7 @@ class EASGD(_AsyncRule):
                  server_addr: str | None = None,
                  session_id: str | None = None,
                  overlap: bool = False,
+                 local_aggregation: bool = False,
                  max_restarts: int = 0, min_workers: int = 1, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
@@ -230,6 +235,29 @@ class EASGD(_AsyncRule):
         else:
             server = EASGDServer(models[0].state.params, alpha=alpha)
         self.server = server
+        # hierarchical aggregation (parallel/aggregate.py): ONE wire
+        # exchange per shard per period for all local workers — the
+        # period mean rides the tagged aggregate op, the pre-update
+        # center fans back over shared memory.  Registered up front so
+        # the first period already aggregates at full fan-in; workers
+        # fall back to direct exchange whenever the plane is down.
+        agg = None
+        if local_aggregation:
+            if len(models) * alpha > 1.0 + 1e-9:
+                raise ValueError(
+                    f"local_aggregation composes the period's elastic "
+                    f"moves against ONE center version, so the center "
+                    f"coefficient is n*alpha = {len(models)}*{alpha} "
+                    f"= {len(models) * alpha:g} > 1 — the center "
+                    "overshoots the worker mean every period and "
+                    "oscillates/diverges.  Lower --alpha to <= "
+                    f"1/{len(models)} (the EASGD paper's beta = "
+                    "N*alpha parameterization; docs/DESIGN.md "
+                    "'Hierarchical exchange')")
+            agg = LocalAggregator("easgd", server, alpha=alpha)
+            for i in range(len(models)):
+                agg.register(i)
+        self.aggregator = agg
         n_epochs = cfg.n_epochs if max_epochs is None else min(cfg.n_epochs,
                                                                start_epoch + max_epochs)
         # supervised recovery (opt-in): a dead worker restarts from the
@@ -263,7 +291,11 @@ class EASGD(_AsyncRule):
             progress = {"epoch": start_epoch}
 
             def work(abort: threading.Event):
-                srv = connect()
+                # aggregated mode: the port submits to the host's
+                # LocalAggregator instead of owning a ServiceClient —
+                # the rule's direct `connect` stays its lazy fallback
+                srv = (AggregatedExchange(agg, rank, connect)
+                       if agg is not None else connect())
                 # overlap mode: this worker's exchange thread — RPCs
                 # run there while the worker computes the next tau
                 # iterations; bounded staleness 1 (docs/DESIGN.md
@@ -346,7 +378,12 @@ class EASGD(_AsyncRule):
                     if pipe is not None:
                         pipe.close()
                     model.cleanup()
-                    if srv is not server and isinstance(
+                    if isinstance(srv, AggregatedExchange):
+                        # leaves the period quorum (a supervised
+                        # restart re-registers) + closes only the
+                        # port's own fallback client
+                        srv.close()
+                    elif srv is not server and isinstance(
                             srv, (ServiceClient, ShardedServiceClient)):
                         srv.close()
 
@@ -421,6 +458,7 @@ class ASGD(_AsyncRule):
                  checkpoint: bool = True, server_addr: str | None = None,
                  session_id: str | None = None,
                  overlap: bool = False,
+                 local_aggregation: bool = False,
                  max_restarts: int = 0, min_workers: int = 1, **kwargs):
         models = self._build_workers(devs, modelfile, modelclass, config,
                                      **kwargs)
@@ -492,6 +530,16 @@ class ASGD(_AsyncRule):
             if restored_opt is not None:
                 server.set_opt_state(restored_opt)
         self.server = server
+        # hierarchical aggregation (parallel/aggregate.py): the local
+        # workers' gradient pushes delta-sum into ONE wire push per
+        # shard per period; the fresh center fans back over shared
+        # memory.  See the EASGD wiring note above.
+        agg = None
+        if local_aggregation:
+            agg = LocalAggregator("asgd", server)
+            for i in range(len(models)):
+                agg.register(i)
+        self.aggregator = agg
         if resume and start_epoch:
             # the restored opt_state carries the old LR; apply the
             # fast-forwarded schedule to the server (LR lives there)
@@ -525,7 +573,9 @@ class ASGD(_AsyncRule):
             progress = {"epoch": start_epoch}
 
             def work(abort: threading.Event):
-                srv = connect()
+                # aggregated mode: see the EASGD worker wiring note
+                srv = (AggregatedExchange(agg, rank, connect)
+                       if agg is not None else connect())
                 # overlap mode: the push_pull RPC for iteration i runs
                 # in the exchange thread while this worker computes
                 # iteration i+1's gradients on its current (one-push-
@@ -643,7 +693,9 @@ class ASGD(_AsyncRule):
                     if pipe is not None:
                         pipe.close()
                     model.cleanup()
-                    if srv is not server and isinstance(
+                    if isinstance(srv, AggregatedExchange):
+                        srv.close()
+                    elif srv is not server and isinstance(
                             srv, (ServiceClient, ShardedServiceClient)):
                         srv.close()
 
@@ -685,10 +737,20 @@ class GOSGD(_AsyncRule):
                  rank_offset: int = 0,
                  session_id: str | None = None,
                  merge_momentum: str = "scale",
+                 local_aggregation: bool = False,
                  max_restarts: int = 0, min_workers: int = 1, **kwargs):
         if merge_momentum not in ("scale", "keep"):
             raise ValueError(f"merge_momentum must be 'scale' or 'keep', "
                              f"got {merge_momentum!r}")
+        if local_aggregation:
+            raise ValueError(
+                "GOSGD refuses hierarchical aggregation: a gossip push "
+                "ships one worker's WHOLE (params, weight) to one "
+                "random peer — there is no per-period center op to "
+                "delta-sum or compose, so an intra-host aggregate has "
+                "nothing exact to send (parallel/aggregate.py applies "
+                "to the EASGD/ASGD center, docs/DESIGN.md "
+                "'Hierarchical exchange')")
         addrs = shard_addresses(server_addr)
         if addrs is not None and len(addrs) > 1:
             raise ValueError(

@@ -371,6 +371,372 @@ def run_sharded(args) -> int:
     return 0 if ok else 1
 
 
+def lattice_tree(target_params: int, seed: int = 0,
+                 grid_bits: int = 10) -> dict:
+    """``resnet50_like_tree`` snapped to the exact-arithmetic f32
+    lattice (integer multiples of 2**-grid_bits, magnitudes << 2**10):
+    every sum/mean/elastic-pull the hierarchical plane computes stays
+    exactly representable, so the trajectory pins compare BITWISE
+    instead of hiding behind a tolerance — f32 associativity cannot
+    blur what the aggregation math actually did.
+
+    ``+ 0.0`` flushes the ``-0.0`` entries ``np.round`` mints for
+    small negatives: IEEE cancellation yields ``+0.0`` while a
+    summed-then-applied ``-0.0`` delta preserves the sign, so signed
+    zeros would flip BYTES between the direct and aggregated paths at
+    exactly-zero positions — numerically equal, bitwise noise."""
+    grid = float(1 << grid_bits)
+    return {k: (np.round(v * grid) / grid + 0.0).astype(np.float32)
+            for k, v in resnet50_like_tree(target_params, seed).items()}
+
+
+def run_hierarchy(args) -> int:
+    """``--local-workers N`` mode (ISSUE 14): hierarchical intra-host
+    aggregation (``parallel/aggregate.py``) against K REAL shard
+    processes, vs N direct per-worker exchanges — per-period wire-byte
+    accounting plus trajectory pins:
+
+    * **EASGD** — the aggregated center must equal the closed-form
+      composition of N same-version exchanges (exact on the
+      lattice-valued tree; f32-tolerance in general —
+      docs/DESIGN.md "Hierarchical exchange").  The direct-vs-
+      aggregated center delta is reported too: a direct chain applies
+      the exchanges sequentially, an O(alpha^2) order effect the doc
+      quantifies.
+    * **ASGD** — the aggregated delta-sum must match N direct
+      same-version pushes BYTE-identically (plain-SGD pushes commute
+      exactly on the lattice), pinning that hierarchy changes where
+      bytes travel, never what the center computes.
+
+    ``--smoke`` is the preflight gate: asserts the N=4 wire-byte
+    reduction (>= 3.9x of the direct baseline — the aggregate frame's
+    multiplier arg costs a few skeleton bytes of the exact 4x), both
+    pins, and the fan-in gauge + ``local_aggregate`` spans in the
+    monitor JSONL; exit 1 otherwise."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    os.environ.setdefault("THEANOMPI_TPU_SERVICE_KEY", "bench-exchange")
+    os.environ.setdefault(
+        "THEANOMPI_TPU_MONITOR",
+        os.path.join(REPO, "artifacts", "bench_exchange_monitor"))
+
+    from theanompi_tpu import monitor
+    from theanompi_tpu.parallel import wire
+    from theanompi_tpu.parallel.aggregate import (
+        AggregatedExchange,
+        LocalAggregator,
+    )
+    from theanompi_tpu.parallel.shards import (
+        ShardProcessGroup,
+        ShardedASGD,
+        ShardedEASGD,
+    )
+
+    n_workers = int(args.local_workers)
+    k = int(args.shards or 1)
+    # --smoke is a GATE, not the artifact: only the asserted (K, N)
+    # combo runs, at 2 periods (the pins need >= 2 to compose) —
+    # the full K x N matrix with wall statistics is the committed-
+    # artifact (non-smoke) run, like every other bench mode's split
+    periods = 2 if args.smoke else max(3, args.exchanges)
+    alpha = 0.25  # N*alpha <= 1 at N=4 (docs/DESIGN.md stability note)
+    base = lattice_tree(int(args.params))
+    n_params = tree_params(base)
+    rng = np.random.default_rng(3)
+    drifts = [
+        {kk: (rng.integers(-64, 65, v.shape) * 2.0**-10)
+         .astype(np.float32) for kk, v in base.items()}
+        for _ in range(n_workers)]
+    print(f"[bench_exchange] hierarchy mode: {n_params/1e6:.1f}M "
+          f"params, {len(base)} leaves, "
+          f"{tree_nbytes(base)/1e6:.1f} MB f32, N in (1, {n_workers}), "
+          f"K in (1, {k})", flush=True)
+    opts = wire.WireOptions.from_env()
+
+    def frame_bytes(op_tuple) -> int:
+        _, _, st = wire.encode_frame(op_tuple, opts)
+        return st.post_bytes
+
+    def shard_subs(client, tree):
+        flat, _ = jax.tree.flatten(tree)
+        flat = [np.asarray(a) for a in flat]
+        return [flat[lo:hi] for lo, hi in client._plan.ranges]
+
+    def worker_start(i):
+        return {kk: base[kk] + drifts[i][kk] for kk in base}
+
+    def run_leg(n_shards, n_local, hierarchical):
+        """One (K, N, mode) leg on a fresh fleet; returns the measured
+        row + the final center (for the trajectory pins)."""
+        group = ShardProcessGroup(n_shards, max_restarts=1)
+        sid = (f"hier-{n_shards}-{n_local}"
+               if hierarchical else f"direct-{n_shards}-{n_local}")
+        srv = ShardedEASGD(group.addresses, base, alpha=alpha,
+                           session_id=sid)
+        try:
+            workers = [worker_start(i) for i in range(n_local)]
+            walls = []
+            if hierarchical:
+                agg = LocalAggregator("easgd", srv, alpha=alpha)
+                ports = [AggregatedExchange(
+                    agg, i, lambda: ShardedEASGD(
+                        group.addresses, None, alpha=alpha,
+                        session_id=sid)) for i in range(n_local)]
+                for _ in range(periods):
+                    outs = [None] * n_local
+                    ths = [threading.Thread(
+                        target=lambda i=i: outs.__setitem__(
+                            i, ports[i].exchange(workers[i])))
+                        for i in range(n_local)]
+                    t0 = time.monotonic()
+                    for t in ths:
+                        t.start()
+                    for t in ths:
+                        t.join()
+                    walls.append((time.monotonic() - t0) * 1e3)
+                    workers = [
+                        {kk: outs[i][kk] + drifts[i][kk] for kk in base}
+                        for i in range(n_local)]
+                for p in ports:
+                    p.close()
+                # wire bytes/period: ONE tagged aggregate sub-exchange
+                # per shard (mean tree out, pre-update center back)
+                per_period = sum(
+                    frame_bytes(("shard_exchange", sid, sub, "cid", 1,
+                                 n_local)) + frame_bytes(("ok", sub))
+                    for sub in shard_subs(srv, base))
+            else:
+                clients = [srv] + [
+                    ShardedEASGD(group.addresses, None, alpha=alpha,
+                                 session_id=sid)
+                    for _ in range(n_local - 1)]
+                for _ in range(periods):
+                    outs = [None] * n_local
+                    ths = [threading.Thread(
+                        target=lambda i=i: outs.__setitem__(
+                            i, clients[i].exchange(workers[i])))
+                        for i in range(n_local)]
+                    t0 = time.monotonic()
+                    for t in ths:
+                        t.start()
+                    for t in ths:
+                        t.join()
+                    walls.append((time.monotonic() - t0) * 1e3)
+                    workers = [
+                        {kk: np.asarray(outs[i][kk]) + drifts[i][kk]
+                         for kk in base} for i in range(n_local)]
+                for c in clients[1:]:
+                    c.close()
+                # wire bytes/period: N full scatters (worker tree out,
+                # new worker tree back, per shard, per worker)
+                per_period = n_local * sum(
+                    frame_bytes(("shard_exchange", sid, sub, "cid", 1))
+                    + frame_bytes(("ok", sub))
+                    for sub in shard_subs(srv, base))
+            center = srv.get_center()
+            return {
+                "wall_ms_mean": round(float(np.mean(walls)), 2),
+                "wall_ms_min": round(float(np.min(walls)), 2),
+                "wire_bytes_per_period": per_period,
+            }, center
+        finally:
+            srv.close()
+            group.stop()
+
+    def easgd_closed_form():
+        """N same-version exchanges per period, composed on host —
+        the reference the aggregated leg is pinned against."""
+        c = {kk: v.copy() for kk, v in base.items()}
+        workers = [worker_start(i) for i in range(n_workers)]
+        a = np.float32(alpha)
+        for _ in range(periods):
+            new_c = {kk: c[kk] + a * sum(w[kk] - c[kk] for w in workers)
+                     for kk in base}
+            workers = [
+                {kk: (w[kk] - a * (w[kk] - c[kk])) + drifts[i][kk]
+                 for kk in base} for i, w in enumerate(workers)]
+            c = new_c
+        return c
+
+    def max_abs_diff(t1, t2) -> float:
+        return max(float(np.max(np.abs(np.asarray(t1[kk])
+                                       - np.asarray(t2[kk]))))
+                   for kk in base)
+
+    def asgd_pin(n_shards) -> bool:
+        """Direct N same-version plain-SGD pushes vs ONE aggregated
+        delta-sum push, on the lattice: byte-identical centers."""
+        small = lattice_tree(int(min(args.params, 2e5)), seed=5)
+        grads = [
+            {kk: (np.random.default_rng(50 + i)
+                  .integers(-8, 9, v.shape) * 2.0**-10)
+             .astype(np.float32) for kk, v in small.items()}
+            for i in range(n_workers)]
+        opt_cfg = dict(learning_rate=0.125, optimizer="sgd")
+        finals = []
+        for mode in ("direct", "hier"):
+            group = ShardProcessGroup(n_shards, max_restarts=1)
+            sid = f"asgd-pin-{mode}-{n_shards}"
+            srv = ShardedASGD(group.addresses, small, opt_cfg,
+                              session_id=sid)
+            try:
+                for _ in range(periods):
+                    if mode == "direct":
+                        for g in grads:
+                            srv.push_pull(g)
+                    else:
+                        gsum = {kk: np.sum([g[kk] for g in grads],
+                                           axis=0, dtype=np.float32)
+                                for kk in small}
+                        srv.push_pull_n(gsum, n_workers)
+                # the pin compares MATH: an at-least-once transport
+                # duplicate (reconnect + re-send under load) would
+                # legitimately shift the center — detect and report it
+                # as transport noise, not a math miss
+                n_updates = srv.n_updates
+                finals.append((srv.get_center(), n_updates))
+            finally:
+                srv.close()
+                group.stop()
+        (c_direct, n_direct), (c_hier, n_hier) = finals
+        expect = periods * n_workers
+        if n_direct != expect or n_hier != expect:
+            print(f"[bench_exchange] asgd pin saw a transport re-send "
+                  f"(updates direct={n_direct} hier={n_hier}, expected "
+                  f"{expect}) — at-least-once duplicate, not a math "
+                  "miss; pin inconclusive this run", file=sys.stderr)
+            return None
+        bad = [kk for kk in small
+               if np.asarray(c_direct[kk]).tobytes()
+               != np.asarray(c_hier[kk]).tobytes()]
+        if bad:
+            worst = max(float(np.max(np.abs(np.asarray(c_direct[kk])
+                                            - np.asarray(c_hier[kk]))))
+                        for kk in bad)
+            print(f"[bench_exchange] asgd pin mismatch on "
+                  f"{len(bad)}/{len(small)} leaves "
+                  f"(max abs diff {worst})", file=sys.stderr)
+        return not bad
+
+    combos = ([(k, n_workers)] if args.smoke else
+              [(s, n) for s in sorted({1, k})
+               for n in sorted({1, n_workers})])
+    modes = []
+    with monitor.session():
+        for n_shards, n_local in combos:
+            direct, d_center = run_leg(n_shards, n_local, False)
+            hier, h_center = run_leg(n_shards, n_local, True)
+            row = {
+                "shards": n_shards, "local_workers": n_local,
+                "periods": periods,
+                "direct": direct, "hierarchical": hier,
+                "wire_byte_reduction_x": round(
+                    direct["wire_bytes_per_period"]
+                    / hier["wire_bytes_per_period"], 4),
+                "wall_delta_vs_direct": round(
+                    1.0 - hier["wall_ms_mean"]
+                    / direct["wall_ms_mean"], 4),
+                "easgd_direct_vs_hier_center_max_abs_diff":
+                    max_abs_diff(d_center, h_center),
+            }
+            if n_local == n_workers:
+                row["easgd_closed_form_max_abs_diff"] = \
+                    max_abs_diff(h_center, easgd_closed_form())
+            modes.append(row)
+            print(f"[bench_exchange] K={n_shards} N={n_local}: "
+                  f"{row['wire_byte_reduction_x']}x fewer wire "
+                  f"bytes/period "
+                  f"({direct['wire_bytes_per_period']/1e6:.1f} -> "
+                  f"{hier['wire_bytes_per_period']/1e6:.1f} MB), "
+                  f"wall {direct['wall_ms_mean']:.0f} -> "
+                  f"{hier['wall_ms_mean']:.0f} ms", flush=True)
+        asgd_identical = asgd_pin(k)
+        if asgd_identical is None:  # transport re-send: one more try
+            asgd_identical = asgd_pin(k)
+        snapshot_path = monitor.flush()
+
+    top = next(m for m in modes
+               if m["shards"] == k and m["local_workers"] == n_workers)
+    out_doc = {
+        "bench": "hierarchical_exchange",
+        "backend": "cpu",
+        "n_params": n_params,
+        "n_leaves": len(base),
+        "tree_mb_f32": round(tree_nbytes(base) / 1e6, 2),
+        "alpha": alpha,
+        "wire": {"compression": opts.compression, "dtype": opts.dtype},
+        "modes": modes,
+        "asgd_delta_sum_byte_identical": asgd_identical,
+        "note": ("trajectory pins on the exact f32 lattice: ASGD "
+                 "byte-identical to N direct same-version pushes; "
+                 "EASGD equal to the closed-form same-version "
+                 "composition (the direct-vs-hier delta is the "
+                 "documented O(alpha^2) sequential-order effect)"),
+    }
+    tag = args.tag or "hierarchy_smoke"
+    path = args.out or os.path.join(REPO, "artifacts",
+                                    f"BENCH_{tag}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out_doc, f, indent=1)
+    print(f"[bench_exchange] wrote {path} "
+          f"(N={n_workers} K={k}: {top['wire_byte_reduction_x']}x "
+          "fewer wire bytes/period)", flush=True)
+
+    if not args.smoke:
+        return 0
+    ok = True
+    if top["wire_byte_reduction_x"] < 3.9 and n_workers >= 4:
+        print(f"[bench_exchange] FAIL: wire-byte reduction "
+              f"{top['wire_byte_reduction_x']}x < 3.9x at "
+              f"N={n_workers}", file=sys.stderr)
+        ok = False
+    if top["hierarchical"]["wire_bytes_per_period"] >= \
+            top["direct"]["wire_bytes_per_period"]:
+        print("[bench_exchange] FAIL: hierarchical wire bytes/period "
+              "not below the direct baseline", file=sys.stderr)
+        ok = False
+    if top.get("easgd_closed_form_max_abs_diff", 1.0) != 0.0:
+        print(f"[bench_exchange] FAIL: EASGD aggregate deviates from "
+              f"the closed form on the exact lattice "
+              f"(max abs diff "
+              f"{top.get('easgd_closed_form_max_abs_diff')})",
+              file=sys.stderr)
+        ok = False
+    if asgd_identical is not True:
+        print("[bench_exchange] FAIL: ASGD delta-sum not "
+              "byte-identical to N direct same-version pushes",
+              file=sys.stderr)
+        ok = False
+    # monitor JSONL: the fan-in gauge + local_aggregate spans are the
+    # operator-facing proof the aggregation plane actually served
+    fan_in, agg_spans = None, 0
+    if snapshot_path and os.path.exists(snapshot_path):
+        with open(snapshot_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("name") == "aggregate/fan_in":
+                    fan_in = rec.get("value")
+                if (rec.get("name") == "span_ms"
+                        and rec.get("labels", {}).get("name")
+                        == "local_aggregate"):
+                    agg_spans = rec.get("count", 0)
+    if fan_in != float(n_workers):
+        print(f"[bench_exchange] FAIL: aggregate/fan_in gauge is "
+              f"{fan_in}, expected {n_workers} (monitor JSONL "
+              f"{snapshot_path})", file=sys.stderr)
+        ok = False
+    if agg_spans <= 0:
+        print("[bench_exchange] FAIL: no local_aggregate spans in the "
+              f"monitor JSONL ({snapshot_path})", file=sys.stderr)
+        ok = False
+    print(f"[bench_exchange] hierarchy smoke {'PASS' if ok else 'FAIL'}",
+          flush=True)
+    return 0 if ok else 1
+
+
 def _bucket_step_equivalence(mesh, B: int) -> bool:
     """Build a real bucketed TRAIN step (collectives embedded in the
     backward via the exchanger's boundary tags) and check it equals
@@ -604,6 +970,23 @@ def main(argv=None) -> int:
                          "report per-shard + aggregate bytes/wall vs "
                          "K=1; with --smoke also kills+recovers a "
                          "shard (the preflight 2-shard gate)")
+    ap.add_argument("--local-workers", type=int, default=None,
+                    metavar="N",
+                    help="hierarchy mode (ISSUE 14): N co-located "
+                         "workers behind one intra-host aggregator "
+                         "(parallel/aggregate.py) vs N direct "
+                         "exchanges, against --shards K real shard "
+                         "processes (default 1) — per-period wire-byte "
+                         "accounting + the ASGD byte-identity / EASGD "
+                         "closed-form trajectory pins; with --smoke "
+                         "asserts the >=3.9x byte reduction and the "
+                         "fan-in gauge + local_aggregate spans (the "
+                         "preflight hierarchy gate).  Mutually "
+                         "exclusive with --buckets (hierarchical "
+                         "aggregation is an async-rules plane; BSP's "
+                         "in-step bucketed exchange refuses it — the "
+                         "same matrix as the GOSGD/BSP launcher "
+                         "refusals)")
     ap.add_argument("--smoke", action="store_true",
                     help="preflight gate: 1 exchange/mode, assert the "
                          "v2 byte win + the monitor gauge, exit 1 on "
@@ -615,6 +998,23 @@ def main(argv=None) -> int:
             "bucket leg measures the in-step SPMD exchange on a device "
             "mesh, the shard leg measures the wire exchange against "
             "real shard processes — run them separately")
+    if args.local_workers is not None and args.buckets is not None:
+        # the sibling of the --buckets/--shards conflict: hierarchical
+        # aggregation applies to the async rules' WIRE exchange; BSP's
+        # bucketed exchange runs inside the step program and refuses
+        # it — exactly the GOSGD/BSP refusal matrix the launcher's
+        # --local-aggregation enforces
+        raise FlagConflict(
+            "--local-workers and --buckets are mutually exclusive: "
+            "hierarchical aggregation is an async-rules (EASGD/ASGD) "
+            "wire plane, while the bucket leg measures BSP's in-step "
+            "SPMD exchange — BSP (like GOSGD) refuses hierarchical "
+            "aggregation (docs/DESIGN.md 'Hierarchical exchange')")
+    if args.local_workers is not None and args.local_workers < 1:
+        raise FlagConflict(
+            f"--local-workers must be >= 1, got {args.local_workers}")
+    if args.local_workers is not None:
+        return run_hierarchy(args)
     if args.buckets is not None:
         return run_buckets(args)
     if args.shards is not None:

@@ -349,6 +349,158 @@ def convoy_point(port: int, n: int, dur_s: float,
 
 
 # ---------------------------------------------------------------------------
+# soak leg — mux byte-identity under sustained load
+# ---------------------------------------------------------------------------
+
+
+def run_soak(args) -> int:
+    """``--soak``: the mux byte-identity pins under sustained load —
+    the CPU gate behind flipping ``THEANOMPI_TPU_SHARD_MUX`` /
+    ``THEANOMPI_TPU_INGEST_MUX`` defaults to ON (ROADMAP item 6
+    leftover).
+
+    Per loop (selector AND threaded): a real server seeded with a
+    known tree; several ``rpc.MuxConnection`` transports, each shared
+    by multiple ``ServiceClient`` streams (the shard-router shape —
+    data + control streams on one socket); reader threads hammer
+    ``easgd_get_center`` for ``--dur`` seconds comparing EVERY reply
+    bitwise to the seeded tree, while writer threads interleave large
+    gossip push/drain frames on the SAME transports.  The threaded
+    loop grants no mux, so the identical client code must silently
+    fall back to dedicated sockets and still hold identity — that
+    fallback is what makes the ON default safe against old servers.
+    Exit 1 on any byte mismatch or transport error."""
+    from theanompi_tpu.parallel import rpc
+    from theanompi_tpu.parallel.service import (
+        RemoteGossipHub,
+        ServiceClient,
+    )
+
+    payload_floats = args.payload_kb * 256
+    ref = np.random.default_rng(0).random(payload_floats) \
+        .astype(np.float32)
+    ref_bytes = ref.tobytes()
+    n_transports, streams_per = 3, 4
+    results = {}
+    for loop in args.loops.split(","):
+        port, srv, init = start_server(loop, payload_floats, None, None)
+        stop_t = time.monotonic() + args.dur
+        counts = {"reads": 0, "writes": 0}
+        errors: list[str] = []
+        mismatches = [0]
+        lock = threading.Lock()
+        try:
+            transports = [rpc.MuxConnection(f"127.0.0.1:{port}")
+                          for _ in range(n_transports)]
+            readers = [ServiceClient(f"127.0.0.1:{port}", transport=t)
+                       for t in transports for _ in range(streams_per)]
+            # one writer hub PER mux transport: the large gossip
+            # frames must chunk-interleave with the identity-checked
+            # reads on the SAME sockets — that interleaving is exactly
+            # the hazard the mux-ON default flip is gated on
+            hubs = [RemoteGossipHub(f"127.0.0.1:{port}", 2,
+                                    session_id=SESSION + "-soak",
+                                    transport=t) for t in transports]
+
+            def read_loop(c):
+                n = 0
+                try:
+                    while time.monotonic() < stop_t:
+                        out = c.call("easgd_get_center", SESSION)
+                        if np.asarray(out["w"]).tobytes() != ref_bytes:
+                            with lock:
+                                mismatches[0] += 1
+                        n += 1
+                except Exception as e:
+                    with lock:
+                        errors.append(f"reader: {type(e).__name__}: {e}")
+                with lock:
+                    counts["reads"] += n
+
+            def write_loop(hub):
+                # big frames both directions on the shared sockets:
+                # gossip push/drain rides its OWN store kind, so the
+                # easgd center the readers pin stays untouched
+                n = 0
+                tree = {"g": ref[: payload_floats // 4]}
+                try:
+                    while time.monotonic() < stop_t:
+                        hub.push(1, tree, 0.01)
+                        hub.drain(1)
+                        n += 1
+                except Exception as e:
+                    with lock:
+                        errors.append(f"writer: {type(e).__name__}: {e}")
+                with lock:
+                    counts["writes"] += n
+
+            ths = [threading.Thread(target=read_loop, args=(c,),
+                                    daemon=True) for c in readers] \
+                + [threading.Thread(target=write_loop, args=(h,),
+                                    daemon=True) for h in hubs]
+            t0 = time.monotonic()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            wall = time.monotonic() - t0
+            muxed = any(getattr(t, "_mux", False) for t in transports)
+            for c in readers:
+                c.close()
+            for h in hubs:
+                h.close()
+            for t in transports:
+                t.close()
+        finally:
+            stop_server(port, srv, init)
+        results[loop] = {
+            "reads": counts["reads"], "writes": counts["writes"],
+            "reads_per_s": round(counts["reads"] / wall, 1),
+            "byte_mismatches": mismatches[0],
+            "errors": errors[:5],
+            "mux_granted": muxed,
+            "streams": n_transports * (streams_per + 1),
+            "dur_s": round(wall, 1),
+        }
+        print(f"[soak] loop={loop:8s} {counts['reads']} identity-"
+              f"checked reads ({results[loop]['reads_per_s']}/s), "
+              f"{counts['writes']} interleaved push/drain rounds, "
+              f"mux_granted={muxed}, mismatches={mismatches[0]}, "
+              f"errors={len(errors)}", flush=True)
+
+    failures = []
+    for loop, r in results.items():
+        if r["byte_mismatches"]:
+            failures.append(f"{loop}: {r['byte_mismatches']} byte "
+                            "mismatches")
+        if r["errors"]:
+            failures.append(f"{loop}: transport errors {r['errors']}")
+        if not r["reads"] or not r["writes"]:
+            failures.append(f"{loop}: no sustained load "
+                            f"(reads={r['reads']}, "
+                            f"writes={r['writes']})")
+    if "selector" in results and not results["selector"]["mux_granted"]:
+        failures.append("selector loop did not grant mux — the soak "
+                        "never exercised stream multiplexing")
+    if "threaded" in results and results["threaded"]["mux_granted"]:
+        failures.append("threaded loop granted mux?! the dedicated-"
+                        "socket fallback went unexercised")
+    out_doc = {"bench": "rpc_soak", "payload_kb": args.payload_kb,
+               "loops": results,
+               "failures": failures, "ok": not failures}
+    out = args.out or os.path.join(REPO, "artifacts",
+                                   "BENCH_rpc_soak.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(out_doc, f, indent=1)
+    for fmsg in failures:
+        print(f"[soak] FAIL: {fmsg}", file=sys.stderr)
+    print(f"[soak] {'PASS' if not failures else 'FAIL'} -> {out}",
+          flush=True)
+    return 0 if not failures else 1
+
+
+# ---------------------------------------------------------------------------
 # main
 # ---------------------------------------------------------------------------
 
@@ -358,6 +510,13 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="preflight gate: 1000-conn flat-p99 + convoy "
                          "recovery assertions, exit 1 on any miss")
+    ap.add_argument("--soak", action="store_true",
+                    help="mux byte-identity soak (the gate behind the "
+                         "SHARD_MUX/INGEST_MUX ON defaults): muxed "
+                         "streams hammer identity-checked reads with "
+                         "interleaved large frames for --dur seconds "
+                         "on BOTH loops (threaded = the dedicated-"
+                         "socket fallback), exit 1 on any mismatch")
     ap.add_argument("--conns", default=None,
                     help="comma-separated connscale points "
                          "(default smoke: 8,1000; full: "
@@ -389,6 +548,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.worker_port is not None:
         return worker_main(args)
+    if args.soak:
+        return run_soak(args)
 
     ncpu = os.cpu_count() or 1
     if args.server_core == -1:

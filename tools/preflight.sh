@@ -362,6 +362,33 @@ PYEOF
   SHARD_RC=$?
   rm -rf "$SHARDDIR"
   echo "shard smoke rc=$SHARD_RC"
+  echo "## hierarchy smoke (4 local workers -> 1 aggregator -> 2 real shard processes, docs/DESIGN.md 'Hierarchical exchange')"
+  # the ISSUE 14 vertical: intra-host aggregation in front of a real
+  # 2-shard fleet.  The gate asserts wire bytes/period land FLAT in N
+  # (>= 3.9x below the 4-worker direct-exchange baseline), the ASGD
+  # delta-sum byte-identity + EASGD closed-form trajectory pins, and
+  # the monitor evidence — aggregate/fan_in gauge at 4 and
+  # local_aggregate spans in the JSONL
+  # (tools/bench_exchange.py --local-workers 4 --shards 2 --smoke)
+  HIERDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$HIERDIR" \
+    python tools/bench_exchange.py --local-workers 4 --shards 2 \
+      --smoke --out "$HIERDIR/BENCH_hierarchy_smoke.json"
+  HIER_RC=$?
+  rm -rf "$HIERDIR"
+  echo "hierarchy smoke rc=$HIER_RC"
+  echo "## rpc soak (mux byte-identity under sustained load, docs/DESIGN.md 'RPC substrate')"
+  # the gate behind the SHARD_MUX/INGEST_MUX ON defaults: muxed
+  # streams hammer identity-checked center reads with interleaved
+  # large gossip frames on BOTH loops; the threaded loop doubles as
+  # the dedicated-socket fallback proof (tools/bench_rpc.py --soak)
+  SOAKDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu \
+    python tools/bench_rpc.py --soak --dur 4 --payload-kb 64 \
+      --out "$SOAKDIR/BENCH_rpc_soak.json"
+  SOAK_RC=$?
+  rm -rf "$SOAKDIR"
+  echo "rpc soak rc=$SOAK_RC"
   echo "## ingest smoke (2-reader fleet over real sockets + kill-recovery, docs/DESIGN.md 'Distributed ingest')"
   # the distributed-ingest vertical end-to-end: two REAL reader
   # processes serving a real mmap shard tree to trainer worker
@@ -398,7 +425,7 @@ PYEOF
   RPC_RC=$?
   rm -rf "$RPCDIR"
   echo "rpc smoke rc=$RPC_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$BUCKET_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$BUCKET_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$HIER_RC" -ne 0 ] || [ "$SOAK_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"
