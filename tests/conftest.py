@@ -63,6 +63,7 @@ def pytest_collection_modifyitems(config, items):
 #: keeps running)
 _REPO_THREAD_NAMES = ("-exchange-", "serving-batcher-",
                       "serving-reload-watcher", "monitor-heartbeat-",
+                      "monitor-export", "collector-watcher",
                       "ingest-", "decode-", "rpc-")
 #: library pools that are non-daemon BY DESIGN and process-lived
 #: (concurrent.futures executors inside jax/orbax) — not leaks

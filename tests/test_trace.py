@@ -1,0 +1,551 @@
+"""Distributed tracing + telemetry collector (ISSUE 16).
+
+The contract under test, end to end over real sockets:
+
+* One logical operation — an EASGD exchange against a 2-shard fleet,
+  a decode GENERATE — assembles into ONE trace with ZERO orphans: the
+  trace context rides the wire-v2 ``TRACE_OP`` envelope, granted
+  bilaterally in the hello, and server-side ``rpc_handle`` spans
+  become children of the caller's open span.
+* Tracing/export disabled is a strict no-op: no trace keys in the
+  hello, no trace fields in open_spans, no event files, no new metric
+  series — the pre-PR surface byte-for-byte.
+* The export path is bounded and non-blocking: a full buffer drops
+  and counts; a dead collector degrades to local-only with an error
+  counter, never an exception into a hot path.
+* Local event JSONLs rotate by size with a keep bound.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theanompi_tpu import monitor
+from theanompi_tpu.monitor import export as mexport
+from theanompi_tpu.monitor import trace
+from theanompi_tpu.monitor.collector import (
+    TelemetryCollector,
+    read_fleet,
+    serve_collector,
+)
+from theanompi_tpu.monitor.export import Exporter, RotatingJsonlWriter
+from theanompi_tpu.monitor.registry import MetricsRegistry
+from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel.service import ServiceClient
+from theanompi_tpu.parallel.shards import ShardedEASGD, serve_shard
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+import traces as traces_tool  # noqa: E402  (tools/traces.py, stdlib-only)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(autouse=True)
+def fresh_monitor():
+    monitor.reset_for_tests()
+    yield
+    monitor.reset_for_tests()
+
+
+@pytest.fixture()
+def service_env(monkeypatch):
+    monkeypatch.setenv("THEANOMPI_TPU_SERVICE_KEY", "trace-test")
+    monkeypatch.setenv("THEANOMPI_TPU_SERVICE_RETRIES", "6")
+    monkeypatch.setenv("THEANOMPI_TPU_SERVICE_RETRY_DEADLINE_S", "20")
+
+
+def _counter(registry, name: str) -> float:
+    return sum(r.get("value", 0.0) for r in registry.snapshot()
+               if r["name"] == name)
+
+
+def _tree(seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    return {"a": rng.standard_normal((8, 4)).astype(np.float32),
+            "b": rng.standard_normal((9,)).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Hello negotiation: the grant is bilateral and off-by-default
+# ---------------------------------------------------------------------------
+
+
+class TestHelloNegotiation:
+    def test_disabled_hello_has_no_trace_key(self):
+        """Byte-identity at the negotiation layer: with tracing off,
+        the hello payload and the accept reply carry exactly the
+        pre-PR keys."""
+        opts = wire.WireOptions()
+        payload = wire.hello_payload(opts)
+        assert "trace" not in payload
+        _, reply, _ = wire.accept_hello(payload)
+        assert "trace" not in reply
+
+    def test_grant_requires_both_sides(self):
+        opts = wire.WireOptions()
+        # client asked, server tracing off -> no grant
+        payload = dict(wire.hello_payload(opts), trace=True)
+        _, reply, _ = wire.accept_hello(payload)
+        assert "trace" not in reply
+        trace.set_enabled(True)
+        try:
+            # both on -> granted
+            _, reply, _ = wire.accept_hello(payload)
+            assert reply.get("trace") is True
+            # server on but client never asked -> still no grant (a
+            # legacy client must never receive an unknown key)
+            _, reply, _ = wire.accept_hello(wire.hello_payload(
+                opts, trace=False))
+            assert "trace" not in reply
+        finally:
+            trace.set_enabled(False)
+
+    def test_attach_wire_rejects_malformed_ctx(self):
+        trace.set_enabled(True)
+        try:
+            for bad in (None, {}, {"t": 7, "s": "a"},
+                        {"t": "x" * 40, "s": "a"}, {"t": "", "s": "a"}):
+                with trace.attach_wire(bad):
+                    assert trace.inject() is None
+        finally:
+            trace.set_enabled(False)
+
+
+# ---------------------------------------------------------------------------
+# One EASGD exchange against a 2-shard fleet = ONE trace, zero orphans
+# ---------------------------------------------------------------------------
+
+
+def _start_shard_fleet(k: int):
+    fleet = []
+    for i in range(k):
+        port = _free_port()
+        ready, stop = threading.Event(), threading.Event()
+        t = threading.Thread(target=serve_shard,
+                             args=("127.0.0.1", port, i, ready, stop),
+                             daemon=True)
+        t.start()
+        assert ready.wait(10)
+        fleet.append({"addr": f"127.0.0.1:{port}", "thread": t,
+                      "stop": stop})
+    return fleet
+
+
+def _stop_shard_fleet(fleet):
+    for s in fleet:
+        s["stop"].set()
+        try:
+            ServiceClient(s["addr"]).call("shutdown")
+        except Exception:
+            pass
+        s["thread"].join(timeout=5)
+
+
+class TestExchangeStitch:
+    def test_two_shard_exchange_is_one_trace(self, service_env,
+                                             rpc_loop, tmp_path,
+                                             monkeypatch):
+        """A root span wrapping one sharded exchange stitches the
+        trainer's fan-out and BOTH shards' ``rpc_handle`` spans into
+        one trace with zero orphans — under the threaded AND the
+        selector RPC loop, over real sockets."""
+        monkeypatch.setenv(trace.ENV_VAR, "1")
+        tree = _tree(0)
+        fleet = _start_shard_fleet(2)
+        try:
+            with monitor.session(run_dir=str(tmp_path)):
+                srv = ShardedEASGD([s["addr"] for s in fleet], tree,
+                                   alpha=0.5,
+                                   session_id=f"tr-{rpc_loop}")
+                w = {k: v + np.float32(0.1) for k, v in tree.items()}
+                with monitor.span("exchange_period"):
+                    srv.exchange(w)
+                srv.close()
+        finally:
+            _stop_shard_fleet(fleet)
+
+        records = traces_tool.load_events(str(tmp_path))
+        assembled = traces_tool.assemble(records)
+        ours = [spans for spans in assembled.values()
+                if any(s["name"] == "exchange_period" for s in spans)]
+        assert len(ours) == 1, \
+            "the root span must appear in exactly one trace"
+        spans = ours[0]
+        assert traces_tool.orphans(spans) == []
+        handled = [s for s in spans if s["name"] == "rpc_handle"]
+        # one exchange fans out to BOTH shards under the same root
+        assert len(handled) >= 2, [s["name"] for s in spans]
+        root = [s for s in spans if s["name"] == "exchange_period"]
+        assert len(root) == 1
+        root_id = root[0]["span"]
+        # every server span is REACHABLE from the root (zero orphans
+        # made parents present; walk up to prove the chain ends at it)
+        by_id = {s["span"]: s for s in spans}
+        for s in handled:
+            node = s
+            while node["parent"] is not None:
+                node = by_id[node["parent"]]
+            assert node["span"] == root_id, \
+                f"rpc_handle {s['span']} roots at {node['name']}"
+        # the tool's critical path starts at the root and descends
+        path = traces_tool.critical_path(spans)
+        assert path and path[0]["span"] == root_id and len(path) >= 2
+
+
+# ---------------------------------------------------------------------------
+# Decode GENERATE: client -> server dispatch -> batcher, one trace
+# ---------------------------------------------------------------------------
+
+
+class TestGenerateStitch:
+    @pytest.mark.slow
+    def test_generate_stitches_client_to_replica(self, service_env,
+                                                 tmp_path, monkeypatch,
+                                                 tmp_path_factory):
+        from theanompi_tpu.models.base import ModelConfig
+        from theanompi_tpu.models.transformer import TransformerLM
+        from theanompi_tpu.serving import (
+            InferenceClient,
+            InferenceServer,
+            export_model,
+            serve,
+        )
+
+        monkeypatch.setenv(trace.ENV_VAR, "1")
+        cfg = ModelConfig(batch_size=4, n_epochs=1, print_freq=0,
+                          compute_dtype="float32", optimizer="adamw",
+                          learning_rate=1e-3, weight_decay=0.0,
+                          lr_schedule="constant")
+        model = TransformerLM(config=cfg, vocab=32, seq_len=16,
+                              n_layers=1, d_model=16, n_heads=2,
+                              verbose=False)
+        export_dir = str(tmp_path_factory.mktemp("trace") / "export")
+        export_model(model, export_dir, version=0)
+
+        server = InferenceServer(
+            export_dir, replicas=1, reload_poll_s=0, model=model,
+            decode=True,
+            decode_opts=dict(page_size=4, pages_per_seq=8, max_seqs=4,
+                             prefill_buckets=(8,))).start()
+        port = _free_port()
+        ready, stop = threading.Event(), threading.Event()
+        t = threading.Thread(target=serve,
+                             args=(server, "127.0.0.1", port, ready,
+                                   stop),
+                             daemon=True)
+        t.start()
+        assert ready.wait(30)
+        addr = f"127.0.0.1:{port}"
+        c = None
+        try:
+            with monitor.session(run_dir=str(tmp_path)):
+                c = InferenceClient(addr)
+                with monitor.span("client_generate"):
+                    out = c.generate(
+                        np.asarray([1, 2, 3], np.int32), 4)
+                assert out is not None and len(out) == 4
+        finally:
+            try:
+                InferenceClient(addr).shutdown()
+            except Exception:
+                stop.set()
+            if c is not None:
+                c.close()
+            t.join(timeout=5)
+            server.stop()
+
+        records = traces_tool.load_events(str(tmp_path))
+        assembled = traces_tool.assemble(records)
+        ours = [spans for spans in assembled.values()
+                if any(s["name"] == "client_generate" for s in spans)]
+        assert len(ours) == 1
+        spans = ours[0]
+        assert traces_tool.orphans(spans) == []
+        names = [s["name"] for s in spans]
+        assert "rpc_handle" in names, names
+        assert any("decode_generate" in n for n in names), names
+
+
+# ---------------------------------------------------------------------------
+# Disabled-mode byte identity
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledNoOp:
+    def test_no_artifacts_no_series_no_span_fields(self, tmp_path):
+        """With no trace env and no collector env, a live monitor
+        session produces exactly the pre-PR artifact set, the span
+        dicts carry no trace fields, and no export series exist."""
+        assert not trace.enabled()
+        with monitor.session(run_dir=str(tmp_path)):
+            with monitor.span("step") as sp:
+                opened = monitor.open_spans()
+                assert opened and all(
+                    "trace" not in d and "span" not in d
+                    for d in opened)
+                assert sp.trace_id is None
+            snap = monitor._state.registry.snapshot()  # noqa: SLF001
+            assert monitor._state.exporter is None  # noqa: SLF001
+        names = {r["name"] for r in snap}
+        assert not any(n.startswith("monitor/export") for n in names)
+        assert "monitor/rotations_total" not in names
+        files = sorted(os.listdir(tmp_path))
+        assert not glob.glob(str(tmp_path / "events_*.jsonl")), files
+        assert not (tmp_path / "fleet.jsonl").exists()
+
+    def test_untraced_wire_messages_unchanged(self):
+        """inject() without an open traced span is None, so the client
+        would send the plain ``(op, *args)`` tuple — no envelope."""
+        assert trace.inject() is None
+        trace.set_enabled(True)
+        try:
+            # enabled but no open span and no remote ctx: still None —
+            # tracing only ever roots at a span, never at a bare call
+            assert trace.inject() is None
+        finally:
+            trace.set_enabled(False)
+
+
+# ---------------------------------------------------------------------------
+# Exporter: bounded drops, collector death, rotation
+# ---------------------------------------------------------------------------
+
+
+class TestExporter:
+    def test_full_buffer_drops_and_counts(self, tmp_path):
+        """A stalled exporter (thread never draining — the degenerate
+        stalled-collector case) drops beyond capacity and counts every
+        drop; emit never blocks or raises."""
+        reg = MetricsRegistry()
+        ex = Exporter(str(tmp_path), "t0", 0, reg, capacity=4)
+        # deliberately NOT started: the buffer can only fill
+        for i in range(10):
+            ex.emit({"event": "span", "i": i})
+        st = ex.stats()
+        assert st["buffered"] == 4 and st["dropped"] == 6
+        assert _counter(reg, "monitor/export_dropped_total") == 6.0
+        ex.stop()
+
+    def test_collector_death_degrades_to_local(self, service_env,
+                                               tmp_path):
+        """Ship to a live collector; kill it; keep emitting: events
+        still land in the LOCAL file, errors are counted, nothing
+        raises — then assert the collector's merged file carries the
+        sender identity it stamped while alive."""
+        col_dir = tmp_path / "col"
+        port = _free_port()
+        ready, stop = threading.Event(), threading.Event()
+        t = threading.Thread(
+            target=serve_collector,
+            args=("127.0.0.1", port, str(col_dir), ready, stop),
+            daemon=True)
+        t.start()
+        assert ready.wait(10)
+        addr = f"127.0.0.1:{port}"
+
+        reg = MetricsRegistry()
+        ex = Exporter(str(tmp_path), "t9", 3, reg, collector=addr,
+                      flush_s=0.05).start()
+        try:
+            ex.emit({"event": "span", "name": "alive", "trace": "aa",
+                     "span": "bb", "t_wall": time.time(),
+                     "dur_s": 0.01})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if _counter(reg, "monitor/export_batches_total") >= 1:
+                    break
+                time.sleep(0.05)
+            assert _counter(reg, "monitor/export_batches_total") >= 1
+            fleet = read_fleet(str(col_dir / "fleet.jsonl"))
+            spans = [r for r in fleet if r.get("event") == "span"]
+            assert spans and spans[0]["role"] == "t9" \
+                and spans[0]["rank"] == 3
+            assert "offset_s" in spans[0]  # clock model rode the batch
+
+            # kill the collector; the exporter must degrade silently
+            stop.set()
+            try:
+                ServiceClient(addr).call("shutdown")
+            except Exception:
+                pass
+            t.join(timeout=5)
+            before_err = _counter(reg, "monitor/export_errors_total")
+            for i in range(3):
+                ex.emit({"event": "span", "name": f"after{i}"})
+                time.sleep(0.1)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if _counter(reg,
+                            "monitor/export_errors_total") > before_err:
+                    break
+                time.sleep(0.05)
+            assert _counter(reg,
+                            "monitor/export_errors_total") > before_err
+        finally:
+            if not stop.is_set():
+                stop.set()
+            ex.stop()
+        local = traces_tool.load_events(str(tmp_path))
+        names = {r.get("name") for r in local}
+        assert "alive" in names and "after0" in names, \
+            "local file must carry events from BOTH sides of the death"
+
+    def test_rotation_keeps_n_and_counts(self, tmp_path):
+        w = RotatingJsonlWriter(str(tmp_path / "e.jsonl"),
+                                max_bytes=120, keep=2)
+        for i in range(40):
+            w.write_lines([json.dumps({"i": i, "pad": "x" * 40})])
+        assert w.rotations >= 2
+        assert os.path.exists(tmp_path / "e.jsonl")
+        assert os.path.exists(tmp_path / "e.jsonl.1")
+        assert os.path.exists(tmp_path / "e.jsonl.2")
+        assert not os.path.exists(tmp_path / "e.jsonl.3")  # keep bound
+        # the newest record is in the live file, in order
+        last = traces_tool.load_events(str(tmp_path / "e.jsonl"))[-1]
+        assert last["i"] == 39
+
+
+# ---------------------------------------------------------------------------
+# Collector service semantics
+# ---------------------------------------------------------------------------
+
+
+class TestCollector:
+    def test_ingest_merges_identity_and_counts(self, tmp_path):
+        col = TelemetryCollector(str(tmp_path))
+        n = col.handle("collector_export",
+                       {"pid": 7, "role": "rank0", "rank": 0,
+                        "offset_s": 0.25, "rtt_s": 0.01},
+                       [{"event": "span", "name": "a"},
+                        {"event": "span", "name": "b"}, "garbage"])
+        assert n == 2  # non-dict events are refused, not crashed on
+        st = col.handle("collector_stats")
+        assert st["events"] == 2 and st["batches"] == 1 \
+            and st["senders"] == 1
+        recs = read_fleet(str(tmp_path / "fleet.jsonl"))
+        assert all(r["pid"] == 7 and r["offset_s"] == 0.25
+                   for r in recs)
+
+    def test_hello_answers_clocks(self, tmp_path):
+        col = TelemetryCollector(str(tmp_path))
+        reply = col.handle("collector_hello", {"pid": 1, "role": "x"})
+        assert abs(reply["t_wall"] - time.time()) < 5.0
+        assert "t_mono" in reply
+
+    def test_malformed_batch_refused(self, tmp_path):
+        col = TelemetryCollector(str(tmp_path))
+        with pytest.raises(ValueError):
+            col.handle("collector_export", "notadict", [])
+        with pytest.raises(ValueError):
+            col.handle("collector_export", {})
+
+
+# ---------------------------------------------------------------------------
+# tools/traces.py analysis semantics (synthetic fixtures)
+# ---------------------------------------------------------------------------
+
+
+def _span(trace_id, span_id, parent, name, t_wall, dur,
+          offset=0.0, pid=1, role="r"):
+    return {"event": "span", "trace": trace_id, "span": span_id,
+            "parent": parent, "name": name, "t_wall": t_wall,
+            "dur_s": dur, "offset_s": offset, "pid": pid, "role": role}
+
+
+class TestTracesTool:
+    def test_offset_correction_aligns_clocks(self):
+        """A child whose raw wall clock is 100s ahead lands INSIDE the
+        parent once its offset_s (estimated at the export handshake)
+        is applied."""
+        recs = [_span("t", "a", None, "root", 1000.0, 1.0),
+                _span("t", "b", "a", "child", 1100.2, 0.1,
+                      offset=-100.0, pid=2)]
+        spans = traces_tool.assemble(recs)["t"]
+        a = next(s for s in spans if s["span"] == "a")
+        b = next(s for s in spans if s["span"] == "b")
+        assert a["t0"] <= b["t0"] and b["t1"] <= a["t1"]
+
+    def test_critical_path_follows_latest_ending_child(self):
+        recs = [_span("t", "a", None, "root", 0.0, 1.0),
+                _span("t", "b", "a", "fast", 0.1, 0.2),
+                _span("t", "c", "a", "slow", 0.1, 0.8),
+                _span("t", "d", "c", "leaf", 0.5, 0.3)]
+        path = traces_tool.critical_path(
+            traces_tool.assemble(recs)["t"])
+        assert [s["name"] for s in path] == ["root", "slow", "leaf"]
+
+    def test_orphans_detected(self):
+        recs = [_span("t", "a", None, "root", 0.0, 1.0),
+                _span("t", "z", "missing", "lost", 0.2, 0.1)]
+        spans = traces_tool.assemble(recs)["t"]
+        assert [s["span"] for s in traces_tool.orphans(spans)] == ["z"]
+
+    def test_idle_gap_detection(self):
+        recs = [_span("t", "a", None, "w1", 0.0, 1.0),
+                _span("t", "b", None, "w2", 0.5, 0.6),
+                # all workers idle from 1.1 to 2.0
+                _span("t", "c", None, "w3", 2.0, 0.5)]
+        spans = traces_tool.spans_of(recs)
+        gaps = traces_tool.idle_gaps(spans, threshold_s=0.5)
+        assert len(gaps) == 1
+        g0, g1 = gaps[0]
+        assert abs(g0 - 1.1) < 1e-9 and abs(g1 - 2.0) < 1e-9
+        assert traces_tool.idle_gaps(spans, threshold_s=1.5) == []
+
+    def test_cli_require_procs(self, tmp_path, capsys):
+        path = tmp_path / "fleet.jsonl"
+        recs = [_span("t", "a", None, "root", 0.0, 1.0, pid=1),
+                _span("t", "b", "a", "mid", 0.1, 0.5, pid=2),
+                _span("t", "c", "b", "leaf", 0.2, 0.2, pid=3)]
+        path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        assert traces_tool.main([str(path), "--require-procs", "3",
+                                 "--require-zero-orphans"]) == 0
+        out = capsys.readouterr().out
+        assert "3 processes" in out and "critical path" in out
+        assert traces_tool.main([str(path),
+                                 "--require-procs", "4"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# tools/tmtop.py: one frame from a synthetic fleet file
+# ---------------------------------------------------------------------------
+
+
+class TestTmtop:
+    def test_once_renders_rates_and_drops(self, tmp_path, capsys):
+        import tmtop
+
+        def metrics(t, count, drops):
+            return {"event": "metrics", "t_wall": t, "role": "rank0",
+                    "pid": 11, "rank": 0,
+                    "snapshot": [
+                        {"name": "step_ms", "kind": "histogram",
+                         "labels": {}, "count": count, "p50": 12.5,
+                         "p99": 30.0},
+                        {"name": "monitor/export_dropped_total",
+                         "kind": "counter", "labels": {},
+                         "value": drops}]}
+
+        path = tmp_path / "fleet.jsonl"
+        path.write_text(json.dumps(metrics(100.0, 10, 0)) + "\n"
+                        + json.dumps(metrics(102.0, 30, 2)) + "\n")
+        assert tmtop.main([str(tmp_path), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "rank0" in out and "1 processes" in out
+        # (30-10 steps) / 2s = 10 steps/s, from consecutive snapshots
+        assert "10.00" in out
+        assert "12.5" in out  # step p50 ms

@@ -55,6 +55,7 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
+from theanompi_tpu.monitor import trace
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.serving.batcher import Overloaded
 
@@ -235,6 +236,17 @@ class ContinuousBatcher:
         blocks until the sequence finishes.  Raises
         :class:`Overloaded` on admission rejection or re-raises the
         step error that consumed this request."""
+        if trace.enabled():
+            # under tracing, a GENERATE handled via rpc_handle (the
+            # serving plane) gets a decode-side child span here — the
+            # client -> server -> replica -> batcher chain closes at
+            # the batcher.  Gated so the untraced hot path (and its
+            # metric stream) is unchanged.
+            with monitor.span("decode_generate", replica=self.replica):
+                return self._generate(prompt, max_new)
+        return self._generate(prompt, max_new)
+
+    def _generate(self, prompt, max_new: int | None = None) -> list[int]:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         max_new = int(max_new if max_new is not None
                       else self.policy.max_new_cap)

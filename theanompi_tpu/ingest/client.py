@@ -64,6 +64,7 @@ from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
 from theanompi_tpu.ingest import protocol
 from theanompi_tpu.ingest.protocol import ingest_addresses  # re-export
+from theanompi_tpu.monitor import trace
 from theanompi_tpu.parallel import wire
 from theanompi_tpu.parallel.rpc import wait_readable as _wait_readable
 from theanompi_tpu.resilience import faults
@@ -118,11 +119,13 @@ class _ReaderPipe:
         host, _, port = addr.rpartition(":")
         self.addr = addr
         self.wire: wire.WireOptions | None = None
+        self.trace = False  # hello grant — batch pulls then carry ctx
         self.fifo: deque = deque()  # (index, t_sent)
         if transport is not None:
             self.conn, pre = transport.connect_stream()
             if pre is not None:
                 self.wire = pre
+                self.trace = transport.trace
                 return  # negotiation inherited from the transport
         else:
             self.conn = _MpClient((host or "127.0.0.1", int(port)),
@@ -137,8 +140,13 @@ class _ReaderPipe:
                     compression=payload.get("compression", "none"),
                     dtype=payload.get("dtype", "f32"),
                     allow_pickle=want.allow_pickle)
+                self.trace = bool(payload.get("trace"))
 
     def send(self, msg) -> None:
+        if self.trace:
+            ctx = trace.inject()
+            if ctx is not None:
+                msg = (wire.TRACE_OP, ctx, *msg)
         if self.wire is not None:
             wire.send_msg(self.conn, msg, self.wire)
         else:
@@ -406,8 +414,20 @@ class RemoteBatchSource:
                 pipe = pipes[addr] = _ReaderPipe(
                     addr, transport=self._transport(addr))
                 by_conn[pipe.conn] = pipe
-            pipe.send((protocol.OP_BATCH, self.epoch, self.rank,
-                       self.size, self.global_batch, idx))
+            if trace.enabled():
+                # each pipelined pull roots its own trace at the send
+                # (nothing else is open on the fetch thread); the
+                # injected context makes the reader's serve span its
+                # child.  Gated so the untraced fetch loop is
+                # unchanged to the byte.
+                with monitor.span("ingest_request", reader=pipe.addr,
+                                  index=str(idx)):
+                    pipe.send((protocol.OP_BATCH, self.epoch,
+                               self.rank, self.size,
+                               self.global_batch, idx))
+            else:
+                pipe.send((protocol.OP_BATCH, self.epoch, self.rank,
+                           self.size, self.global_batch, idx))
             pipe.fifo.append((idx, time.monotonic()))
             return True
         except CONNECTION_ERRORS:

@@ -269,6 +269,17 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                         "under DIR; equivalent to setting "
                         "THEANOMPI_TPU_MONITOR=DIR "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--collector", action="store_true",
+                   help="spawn + supervise a telemetry collector for "
+                        "this run (monitor/collector.py): every process "
+                        "ships span/metric events to ONE merged "
+                        "fleet.jsonl under --monitor-dir (required); "
+                        "enables distributed tracing "
+                        "(THEANOMPI_TPU_TRACE=1, unless already set) "
+                        "and exports THEANOMPI_TPU_COLLECTOR so shard/"
+                        "reader/serve subprocesses ship too.  Inspect "
+                        "with tools/traces.py and tools/tmtop.py "
+                        "(docs/OBSERVABILITY.md 'Distributed tracing')")
     if multihost:
         p.add_argument("--coordinator", required=True,
                        help="host:port of host 0 (jax.distributed)")
@@ -331,6 +342,44 @@ def _resolve_model(args) -> tuple[str, str]:
 
 
 def _run(args, multihost: bool) -> int:
+    """Collector seam around the session: the collector must be up
+    (and ``THEANOMPI_TPU_COLLECTOR`` exported) BEFORE any monitor
+    session activates — the exporter reads the address once at session
+    start — and must outlive the session's final flush."""
+    collector = None
+    if getattr(args, "collector", False):
+        if multihost:
+            # one collector per RUN, not per host: start it once
+            # (python -m theanompi_tpu.monitor.collector) and export
+            # THEANOMPI_TPU_COLLECTOR on every host instead
+            raise SystemExit(
+                "--collector is single-host (tmlocal spawns the "
+                "collector process); multi-host runs start one "
+                "collector and export THEANOMPI_TPU_COLLECTOR=host:port "
+                "on every host")
+        if not args.monitor_dir:
+            raise SystemExit("--collector requires --monitor-dir (the "
+                             "merged fleet.jsonl lands there)")
+        import os
+
+        # export before spawning so the collector's own artifacts land
+        # under the run dir too
+        os.environ["THEANOMPI_TPU_MONITOR"] = args.monitor_dir
+        from theanompi_tpu.monitor.collector import CollectorProcess
+
+        collector = CollectorProcess(args.monitor_dir)
+        # a collector without tracing still merges fleet metrics, but
+        # the flag's point is the one-timeline view — turn tracing on
+        # unless the operator pinned it (e.g. =0 to sample metrics only)
+        os.environ.setdefault("THEANOMPI_TPU_TRACE", "1")
+    try:
+        return _run_session(args, multihost)
+    finally:
+        if collector is not None:
+            collector.stop()
+
+
+def _run_session(args, multihost: bool) -> int:
     if args.monitor_dir:
         # the env var is THE activation channel: the rule session, the
         # recorder, the service clients, and any subprocess this run

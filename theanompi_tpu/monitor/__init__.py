@@ -62,6 +62,7 @@ from theanompi_tpu.monitor.registry import (
     tree_dtypes,
 )
 from theanompi_tpu.monitor.spans import NULL_SPAN, Span, open_spans
+from theanompi_tpu.monitor import trace
 
 ENV_VAR = "THEANOMPI_TPU_MONITOR"
 
@@ -74,6 +75,7 @@ __all__ = [
     "inc", "set_gauge", "add_gauge", "observe", "span", "progress",
     "observe_step", "flush", "dump_postmortem", "open_spans",
     "tree_bytes", "tree_dtypes", "reset_for_tests", "snapshot_path",
+    "trace",
 ]
 
 
@@ -91,6 +93,7 @@ class _State:
         self.suffix = "rank0"
         self.heartbeat: HeartbeatReporter | None = None
         self.straggler: StragglerDetector | None = None
+        self.exporter = None  # monitor/export.py Exporter when tracing
         self.recent_steps: deque[float] = deque(maxlen=RECENT_STEPS)
         self.depth = 0
 
@@ -194,6 +197,16 @@ def _activate(run_dir: str, rank: int, interval: float | None,
         suffix=_state.suffix,
     ).start()
     _state.registry.set_gauge("monitor/enabled", 1.0)
+    # tracing/export ride the session lifecycle: re-read the env
+    # switches here (so launcher-exported vars take effect) and start
+    # the exporter only when tracing or a collector is configured —
+    # otherwise nothing below allocates and the strict no-op contract
+    # of the disabled path is untouched
+    trace.activate_from_env()
+    from theanompi_tpu.monitor import export as _export
+
+    _state.exporter = _export.maybe_start(
+        run_dir, _state.suffix, rank, _state.registry)
     _state.enabled = True
 
 
@@ -206,6 +219,12 @@ def _finalize() -> None:
     hb, _state.heartbeat = _state.heartbeat, None
     if hb is not None:
         hb.stop()
+    ex, _state.exporter = _state.exporter, None
+    if ex is not None:
+        from theanompi_tpu.monitor import export as _export
+
+        _export.set_exporter(None)
+        ex.stop()
     run_dir, suffix = _state.run_dir, _state.suffix
     if run_dir is not None:
         try:
@@ -228,6 +247,13 @@ def reset_for_tests() -> None:
         hb = _state.heartbeat
         if hb is not None:
             hb.stop()
+        ex = _state.exporter
+        if ex is not None:
+            from theanompi_tpu.monitor import export as _export
+
+            _export.set_exporter(None)
+            ex.stop()
+        trace.reset_for_tests()
         _state = _State()
 
 

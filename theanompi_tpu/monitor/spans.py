@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any
 
+from theanompi_tpu.monitor import trace as _trace
+
 _local = threading.local()
 
 #: all currently-open spans across threads: id(span) -> Span.  The
@@ -63,7 +65,8 @@ class Span:
     uses that mode when it only wants TraceAnnotation alignment."""
 
     __slots__ = ("name", "full_name", "labels", "fence_on", "registry",
-                 "t0", "thread", "_annotation", "_annotate")
+                 "t0", "t_wall", "thread", "_annotation", "_annotate",
+                 "trace_id", "span_id", "parent_id", "sampled")
 
     def __init__(self, name: str, registry=None, fence: Any = None,
                  annotate: bool = True, **labels):
@@ -73,9 +76,16 @@ class Span:
         self.fence_on = fence
         self.registry = registry
         self.t0 = 0.0
+        self.t_wall = 0.0
         self.thread = threading.current_thread().name
         self._annotate = annotate
         self._annotation = None
+        # trace linkage — ids stay None unless tracing is enabled at
+        # __enter__, so the disabled path allocates nothing
+        self.trace_id: str | None = None
+        self.span_id: str | None = None
+        self.parent_id: str | None = None
+        self.sampled = False
 
     def __enter__(self) -> "Span":
         # t0 must be set before the span becomes globally visible, or
@@ -85,6 +95,10 @@ class Span:
         st = _stack()
         if st:
             self.full_name = f"{st[-1].full_name}/{self.name}"
+        if _trace.enabled():
+            (self.trace_id, self.span_id,
+             self.parent_id, self.sampled) = _trace.begin(
+                st[-1] if st else None)
         st.append(self)
         with _open_lock:
             _open[id(self)] = self
@@ -102,8 +116,11 @@ class Span:
                 # never run __exit__, leaking a ghost open span)
                 self._annotation = None
         # re-stamp after annotation setup so its cost (first jax
-        # import can be slow) isn't charged to the timed block
+        # import can be slow) isn't charged to the timed block; the
+        # wall stamp pairs with the SAME instant so merged timelines
+        # and in-process interval math describe one interval
         self.t0 = time.monotonic()
+        self.t_wall = time.time()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
@@ -138,6 +155,8 @@ class Span:
                 if exc_type is not None:
                     self.registry.inc("span_errors_total",
                                       name=self.full_name)
+            if self.trace_id is not None:
+                _trace.record_span(self, dt, exc_type is not None)
 
     @property
     def age_s(self) -> float:
@@ -170,6 +189,13 @@ def open_spans() -> list[dict]:
     with _open_lock:
         spans = list(_open.values())
     spans.sort(key=lambda s: s.t0)
-    return [{"name": s.full_name, "thread": s.thread,
+    out = []
+    for s in spans:
+        d = {"name": s.full_name, "thread": s.thread,
              "age_s": round(s.age_s, 3), "labels": s.labels}
-            for s in spans]
+        if s.trace_id is not None:  # only under tracing — the
+            # disabled-mode snapshot stays byte-identical to pre-trace
+            d["trace"] = s.trace_id
+            d["span"] = s.span_id
+        out.append(d)
+    return out

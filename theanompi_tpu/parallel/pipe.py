@@ -17,6 +17,7 @@ import threading
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_lock
+from theanompi_tpu.monitor import trace
 
 #: _ExchangePipe shutdown sentinel
 _STOP = object()
@@ -72,12 +73,19 @@ class _ExchangePipe:
             item = self._req.get()
             if item is _STOP:
                 return
+            payload, ctx = item
             try:
-                with monitor.span(self._span, worker=self._worker):
-                    out = (self._fn(item), None)
+                # the submitter's captured trace context re-attaches
+                # here, so the exchange span (and the RPC it wraps)
+                # stays a child of the submitting worker's span even
+                # though it runs on this thread — without the handoff,
+                # every overlapped exchange would root its own trace
+                with trace.attach_wire(ctx), \
+                        monitor.span(self._span, worker=self._worker):
+                    out = (self._fn(payload), None)
             except BaseException as e:  # surfaced at collect()
                 out = (None, e)
-            self._res.put((item, out))
+            self._res.put((payload, out))
 
     def busy(self) -> bool:
         """Locked read of the barrier flag — the worker loop's drain
@@ -105,8 +113,10 @@ class _ExchangePipe:
             self.outstanding = True
         try:
             # queue put outside the lock: it can block when the
-            # exchange thread still holds the previous item
-            self._req.put(payload)
+            # exchange thread still holds the previous item; the trace
+            # context is captured HERE, on the submitting thread, where
+            # the caller's span is still open
+            self._req.put((payload, trace.capture()))
         except BaseException:
             with self._lock:
                 self.outstanding = False

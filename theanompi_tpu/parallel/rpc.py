@@ -82,6 +82,7 @@ from typing import Any, Callable
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
+from theanompi_tpu.monitor import trace as _trace
 from theanompi_tpu.parallel import wire
 
 __all__ = [
@@ -444,6 +445,10 @@ def _serve_threaded(service, host: str, port: int,
         # per-connection protocol state: None = v1 pickle; a
         # successful wire_hello switches BOTH directions to v2 framing
         wire_opts: wire.WireOptions | None = None
+        # trace grant from the hello: only then may the peer send the
+        # TRACE_OP context envelope (without it the op falls through to
+        # service.handle and earns the ordinary unknown-op error)
+        trace_on = False
 
         def reply(payload, op: str = "reply"):
             """True = sent; 'degraded' = serialize failure converted
@@ -531,6 +536,7 @@ def _serve_threaded(service, host: str, port: int,
                     if not reply(("ok", hello_reply)):
                         return
                     wire_opts = negotiated
+                    trace_on = bool(hello_reply.get("trace"))
                     hooks.on_negotiate(negotiated)
                     continue
                 if op == "shutdown":
@@ -543,10 +549,21 @@ def _serve_threaded(service, host: str, port: int,
                     except OSError:
                         pass
                     return
+                ctx = None
+                if op == wire.TRACE_OP and trace_on and len(args) >= 2:
+                    ctx, op, *args = args
                 t0 = time.monotonic()
                 try:
                     hooks.fire(op)
-                    result = service.handle(op, *args)
+                    if ctx is not None:
+                        # the span exists only on traced requests, so
+                        # the untraced hot path (and its metric stream)
+                        # is byte-identical to the pre-trace build
+                        with _trace.attach_wire(ctx), \
+                                monitor.span("rpc_handle", op=op):
+                            result = service.handle(op, *args)
+                    else:
+                        result = service.handle(op, *args)
                 except Exception as e:  # surfaced client-side
                     hooks.on_error(op)
                     if not reply(("err", f"{type(e).__name__}: {e}")):
@@ -682,6 +699,10 @@ class _SelConn:
         self.parser = _ChunkParser()
         self.wire_opts: wire.WireOptions | None = None
         self.mux = False
+        # trace grant — written once at hello (IO thread) strictly
+        # before any enveloped request, read by workers: same
+        # ordering argument as wire_opts above
+        self.trace = False
         self.cur_sid: int | None = None
         self.streams: dict[int, _Stream] = {}
         self.events = selectors.EVENT_READ
@@ -1075,6 +1096,7 @@ class _SelectorServer:
                                        f"{type(e).__name__}: {e}"))
             ok = self._reply_io(conn, st.sid, ("ok", hello_reply))
             conn.wire_opts = negotiated
+            conn.trace = bool(hello_reply.get("trace"))
             if mux:
                 conn.mux = True
                 # stream 0 was only the pre-mux channel — retire it
@@ -1092,44 +1114,59 @@ class _SelectorServer:
             self._flush(conn)
             self.stop_event.set()
             return True
-        return self._submit(conn, st, op, args)
+        ctx = None
+        if op == wire.TRACE_OP and conn.trace and len(args) >= 2:
+            # caller's trace context rides as an envelope; only
+            # unwrapped when the hello granted it (otherwise the op
+            # falls through to the service's unknown-op error)
+            ctx, op, *args = args
+        return self._submit(conn, st, op, args, ctx)
 
-    def _submit(self, conn: _SelConn, st: _Stream, op, args) -> bool:
+    def _submit(self, conn: _SelConn, st: _Stream, op, args,
+                ctx=None) -> bool:
         with conn._slock:
             if st.busy:
-                st.pending.append((op, args))
+                st.pending.append((op, args, ctx))
                 return True
             st.busy = True
         pool = self.ctl_pool if op in self._control else self.pool
         try:
-            pool.submit(lambda: self._run_stream(conn, st, op, args))
+            pool.submit(
+                lambda: self._run_stream(conn, st, op, args, ctx))
         except RuntimeError:  # shutting down
             return False
         return True
 
     # -- worker side ------------------------------------------------------
 
-    def _run_stream(self, conn: _SelConn, st: _Stream, op, args) -> None:
+    def _run_stream(self, conn: _SelConn, st: _Stream, op, args,
+                    ctx=None) -> None:
         """Execute requests of ONE stream serially (replies stay FIFO
         per stream; streams of one connection run concurrently)."""
         while True:
             if op == self._REPLY_OP:
                 self._reply(conn, st.sid, args)  # pre-built diagnostic
             else:
-                self._run_one(conn, st.sid, op, args)
+                self._run_one(conn, st.sid, op, args, ctx)
             with conn._slock:
                 if st.pending:
-                    op, args = st.pending.popleft()
+                    op, args, ctx = st.pending.popleft()
                     continue
                 st.busy = False
                 return
 
-    def _run_one(self, conn: _SelConn, sid: int, op, args) -> None:
+    def _run_one(self, conn: _SelConn, sid: int, op, args,
+                 ctx=None) -> None:
         t0 = time.monotonic()
         try:
             self.hooks.fire(op)
-            with monitor.span("rpc_handle", op=op):
-                result = self.service.handle(op, *args)
+            if ctx is not None:
+                with _trace.attach_wire(ctx), \
+                        monitor.span("rpc_handle", op=op):
+                    result = self.service.handle(op, *args)
+            else:
+                with monitor.span("rpc_handle", op=op):
+                    result = self.service.handle(op, *args)
         except Exception as e:  # surfaced client-side
             self.hooks.on_error(op)
             self._reply(conn, sid, ("err", f"{type(e).__name__}: {e}"))
@@ -1437,6 +1474,7 @@ class MuxConnection:
         self._conn = None           # guarded_by: self._lock
         self._mux: bool | None = None  # guarded_by: self._lock
         self._wire: wire.WireOptions | None = None  # guarded_by: self._lock
+        self._trace = False         # guarded_by: self._lock
         self._streams: dict[int, _ChunkQueue] = {}  # guarded_by: self._lock
         self._next_sid = 1          # guarded_by: self._lock
         self._gen = 0               # guarded_by: self._lock
@@ -1476,6 +1514,9 @@ class MuxConnection:
             compression=payload.get("compression", "none"),
             dtype=payload.get("dtype", "f32"),
             allow_pickle=self._want.allow_pickle)
+        # the shared hello negotiated for every stream on this socket;
+        # ServiceClient reads it when it skips its own hello
+        self._trace = bool(payload.get("trace"))
         self._gen += 1
         threading.Thread(
             target=self._read_loop, args=(conn, self._gen),
@@ -1487,6 +1528,12 @@ class MuxConnection:
     def mux(self) -> bool:
         with self._lock:
             return bool(self._mux)
+
+    @property
+    def trace(self) -> bool:
+        """Whether the shared hello granted trace propagation."""
+        with self._lock:
+            return self._trace
 
     def connect_stream(self):
         """-> (conn-like, negotiated WireOptions | None).

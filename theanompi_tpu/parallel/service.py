@@ -73,6 +73,7 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_lock
+from theanompi_tpu.monitor import trace
 from theanompi_tpu.parallel import rpc, wire
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
@@ -481,6 +482,9 @@ class ServiceClient:
                            else wire.WireOptions.from_env())
         #: negotiated per-connection: None = v1 pickle
         self._wire: wire.WireOptions | None = None
+        #: trace grant from the hello: only then does _call_once wrap
+        #: requests in the wire.TRACE_OP context envelope
+        self._trace = False
         self._lock = threading.Lock()
         #: optional shared multiplexed transport (parallel/rpc.py):
         #: this client becomes one logical stream on the transport's
@@ -503,6 +507,7 @@ class ServiceClient:
                         "protocol='v1' cannot ride a multiplexed "
                         "transport — mux streams are wire-v2 framed")
                 self._wire = pre
+                self._trace = self._transport.trace
                 return
         else:
             with self._lock:
@@ -525,6 +530,7 @@ class ServiceClient:
         "unknown op" and the connection stays on v1 pickle — the
         fallback is silent by design (old tmservers keep working)."""
         self._wire = None
+        self._trace = False
         if not self._want_v2:
             return
         with self._lock:
@@ -537,6 +543,9 @@ class ServiceClient:
                 compression=payload.get("compression", "none"),
                 dtype=payload.get("dtype", "f32"),
                 allow_pickle=self._wire_opts.allow_pickle)
+            # absent from a legacy server's reply — trace propagation
+            # degrades silently, like compression/dtype
+            self._trace = bool(payload.get("trace"))
 
     def _reconnect(self) -> None:
         with self._lock:
@@ -559,16 +568,25 @@ class ServiceClient:
         are tagged with whether the request had already been SENT —
         the retry loop needs it to keep AT_MOST_ONCE_OPS from being
         re-applied after a lost reply."""
+        msg = (op, *args)
+        if self._trace:
+            # the caller's open span (or attached remote context)
+            # becomes the server-side parent; nothing open -> plain
+            # message, and the envelope is never sent without the
+            # hello grant, so legacy servers never see TRACE_OP
+            ctx = trace.inject()
+            if ctx is not None:
+                msg = (wire.TRACE_OP, ctx, *msg)
         with self._lock:
             sent = False
             try:
                 if self._wire is not None:
-                    wire.send_msg(self._conn, (op, *args), self._wire)
+                    wire.send_msg(self._conn, msg, self._wire)
                     sent = True
                     status, payload = wire.recv_msg(self._conn,
                                                     self._wire)
                 else:
-                    self._conn.send((op, *args))
+                    self._conn.send(msg)
                     sent = True
                     status, payload = self._conn.recv()
             except CONNECTION_ERRORS as e:
@@ -980,10 +998,14 @@ class ShardedServiceClient:
         n = self.n_shards
         outs: list = [None] * n
         errs: list = [None] * n
+        # captured on the calling thread so every per-shard RPC stays
+        # inside the caller's trace instead of rooting its own
+        ctx = trace.capture()
 
         def run(i: int) -> None:
             try:
-                outs[i] = fn(i)
+                with trace.attach_wire(ctx):
+                    outs[i] = fn(i)
             except BaseException as e:
                 errs[i] = e
 

@@ -58,6 +58,7 @@ from typing import Any
 import numpy as np
 
 from theanompi_tpu import monitor
+from theanompi_tpu.monitor import trace as _trace
 
 try:  # jax dependency; the bf16 wire dtype needs it as a numpy dtype
     import ml_dtypes
@@ -599,10 +600,26 @@ def _iter_arrays(obj: Any):
 #: the client stays on v1 pickle.
 HELLO_OP = "wire_hello"
 
+#: trace-context envelope: a client that was granted ``trace`` in the
+#: hello may send ``(TRACE_OP, ctx_dict, real_op, *args)`` — the server
+#: unwraps the context and dispatches ``real_op`` under it, so its
+#: spans become children of the caller's span.  Never sent without the
+#: grant, so a legacy server (which would answer "unknown op") never
+#: sees it — the same silent-degradation contract as compression/dtype.
+TRACE_OP = "wire_trace_ctx"
 
-def hello_payload(opts: WireOptions) -> dict:
-    return {"version": WIRE_VERSION, "compression": opts.compression,
-            "dtype": opts.dtype}
+
+def hello_payload(opts: WireOptions, trace: bool | None = None) -> dict:
+    """The client's hello.  ``trace=None`` (every existing caller)
+    auto-requests trace propagation when tracing is enabled in this
+    process — one switch lights up every client in the fleet."""
+    out = {"version": WIRE_VERSION, "compression": opts.compression,
+           "dtype": opts.dtype}
+    if trace is None:
+        trace = _trace.enabled()
+    if trace:
+        out["trace"] = True
+    return out
 
 
 def accept_hello(payload: Any, allow_mux: bool = False
@@ -637,7 +654,11 @@ def accept_hello(payload: Any, allow_mux: bool = False
     # authenticated-but-hostile peer must not reach pickle.loads
     opts = WireOptions(compression=comp, dtype=dtype, allow_pickle=False)
     mux = bool(allow_mux and payload.get("mux"))
-    reply = hello_payload(opts)
+    # the grant is bilateral: the client asked AND this server has
+    # tracing on — a reply without the key tells the client to never
+    # send the TRACE_OP envelope on this connection
+    reply = hello_payload(opts, trace=bool(payload.get("trace")
+                                           and _trace.enabled()))
     if mux:
         reply["mux"] = True
     return opts, reply, mux

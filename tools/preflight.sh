@@ -82,6 +82,141 @@ PYEOF
   MONITOR_RC=$?
   rm -rf "$MONDIR"
   echo "monitor smoke rc=$MONITOR_RC"
+  echo "## collector smoke (distributed tracing: trainer -> 2 real shard processes + concurrent decode GENERATE -> one collector, docs/OBSERVABILITY.md 'Distributed tracing')"
+  # the ISSUE 16 vertical end-to-end: a supervised collector process, a
+  # REAL 2-shard EASGD fleet, and a concurrent decode GENERATE, all
+  # shipping span/metric events to ONE merged fleet.jsonl.  The gate
+  # asserts (a) the exchange reconstructs as a single trace spanning
+  # >= 3 PROCESSES with zero orphans, (b) the GENERATE reconstructs as
+  # a single client->rpc_handle->decode_generate trace, (c)
+  # tools/traces.py prints the critical path and runs the
+  # idle-all-workers gap detector on the merged stream, and (d)
+  # tools/tmtop.py renders a fleet frame from the shipped metrics
+  COLDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$COLDIR" python - <<'PYEOF'
+import os, socket, sys, threading, time
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+os.environ["THEANOMPI_TPU_TRACE"] = "1"  # before any child spawns
+from theanompi_tpu import monitor
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.models.transformer import TransformerLM
+from theanompi_tpu.monitor.collector import CollectorProcess
+from theanompi_tpu.parallel.shards import (ShardProcessGroup,
+                                           ShardedEASGD,
+                                           shard_addresses)
+from theanompi_tpu.serving import (InferenceClient, InferenceServer,
+                                   export_model, serve)
+
+mondir = os.environ["THEANOMPI_TPU_MONITOR"]
+col = CollectorProcess(mondir)  # exports THEANOMPI_TPU_COLLECTOR
+group = ShardProcessGroup(2, max_restarts=1)  # inherits trace+collector
+try:
+    cfg = ModelConfig(batch_size=4, n_epochs=1, print_freq=0,
+                      compute_dtype="float32", optimizer="adamw",
+                      learning_rate=1e-3, weight_decay=0.0,
+                      lr_schedule="constant")
+    lm = TransformerLM(config=cfg, vocab=32, seq_len=16, n_layers=1,
+                       d_model=16, n_heads=2, verbose=False)
+    export_dir = os.path.join(mondir, "export")
+    export_model(lm, export_dir, version=0)
+    rng = np.random.default_rng(0)
+    tree = {"a": rng.standard_normal((64, 8)).astype(np.float32),
+            "b": rng.standard_normal((33,)).astype(np.float32)}
+    with monitor.session(run_dir=mondir, stall_after=float("inf")):
+        server = InferenceServer(
+            export_dir, replicas=1, reload_poll_s=0, model=lm,
+            decode=True,
+            decode_opts=dict(page_size=4, pages_per_seq=8, max_seqs=4,
+                             prefill_buckets=(8,))).start()
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        ready = threading.Event()
+        t = threading.Thread(target=serve,
+                             args=(server, "127.0.0.1", port, ready),
+                             daemon=True)
+        t.start()
+        assert ready.wait(30)
+        c = InferenceClient(f"127.0.0.1:{port}")
+        gen_out = {}
+
+        def gen():
+            with monitor.span("client_generate"):
+                gen_out["toks"] = c.generate(
+                    np.asarray([1, 2, 3], np.int32), 6)
+
+        gt = threading.Thread(target=gen)
+        gt.start()  # concurrent with the exchange leg, per the gate
+        srv = ShardedEASGD(shard_addresses(group.server_addr), tree,
+                           alpha=0.5, session_id="preflight-trace")
+        for n in range(3):
+            w = jax.tree.map(lambda x: x + np.float32(0.05 * (n + 1)),
+                             tree)
+            with monitor.span("exchange_period"):
+                srv.exchange(w)
+        srv.close()
+        gt.join(120)
+        assert gen_out.get("toks") is not None \
+            and len(gen_out["toks"]) == 6
+        c.shutdown()
+        c.close()
+        t.join(timeout=5)
+        server.stop()
+        time.sleep(1.5)  # let the shard exporters flush their tails
+    # session exit flushed the trainer's exporter; the fleet file now
+    # carries >= 3 processes (trainer + 2 shards) + the collector meta
+    st = col.stats()
+    assert st and st["events"] > 0 and st["senders"] >= 3, st
+    sys.path.insert(0, os.path.join(os.getcwd(), "tools"))
+    import traces as traces_tool
+    records = traces_tool.load_events(os.path.join(mondir,
+                                                   "fleet.jsonl"))
+    tr = traces_tool.assemble(records)
+    ex = [s for s in tr.values()
+          if any(x["name"] == "exchange_period" for x in s)]
+    assert ex, "no exchange trace reached the collector"
+    stitched = [s for s in ex
+                if len(traces_tool.processes_of(s)) >= 3
+                and not traces_tool.orphans(s)]
+    assert stitched, [
+        (len(s), sorted(traces_tool.processes_of(s)),
+         len(traces_tool.orphans(s))) for s in ex]
+    gen_tr = [s for s in tr.values()
+              if any(x["name"] == "client_generate" for x in s)]
+    assert len(gen_tr) == 1 and not traces_tool.orphans(gen_tr[0]), \
+        "GENERATE must reconstruct as ONE trace with zero orphans"
+    names = [x["name"] for x in gen_tr[0]]
+    assert any("rpc_handle" in n for n in names), names
+    assert any("decode_generate" in n for n in names), names
+    print(f"collector smoke OK: {st['events']} events from "
+          f"{st['senders']} senders, exchange trace spans "
+          f"{len(traces_tool.processes_of(stitched[0]))} processes "
+          f"({len(stitched[0])} spans, 0 orphans), GENERATE stitched "
+          f"({len(gen_tr[0])} spans)")
+finally:
+    group.stop()
+    col.stop()
+PYEOF
+  COLLECTOR_RC=$?
+  if [ "$COLLECTOR_RC" -eq 0 ]; then
+    # the consumer tools over the SAME merged file: traces.py must
+    # confirm a >=3-process orphan-free trace, print its critical
+    # path, and run the idle-gap detector; tmtop must render a frame
+    python tools/traces.py "$COLDIR" --require-procs 3 --gap-ms 5000 \
+      > "$COLDIR/traces.out" 2>&1
+    TRACES_RC=$?
+    grep -q "critical path" "$COLDIR/traces.out" || TRACES_RC=1
+    grep -q "idle-all-workers gaps" "$COLDIR/traces.out" || TRACES_RC=1
+    sed -n '1,12p' "$COLDIR/traces.out"
+    python tools/tmtop.py "$COLDIR" --once || TRACES_RC=1
+    COLLECTOR_RC=$TRACES_RC
+  fi
+  rm -rf "$COLDIR"
+  echo "collector smoke rc=$COLLECTOR_RC"
   echo "## resilience smoke (EASGD kill-and-recover via THEANOMPI_TPU_FAULTS)"
   # fault-injection end-to-end (docs/RESILIENCE.md): kill worker 1 at
   # step 3 of a tiny EASGD session; supervised recovery must restart
@@ -425,7 +560,7 @@ PYEOF
   RPC_RC=$?
   rm -rf "$RPCDIR"
   echo "rpc smoke rc=$RPC_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$BUCKET_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$HIER_RC" -ne 0 ] || [ "$SOAK_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$COLLECTOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$BUCKET_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$HIER_RC" -ne 0 ] || [ "$SOAK_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"

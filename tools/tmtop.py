@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""tmtop — live fleet view from the telemetry collector's JSONL.
+
+Tails ``fleet.jsonl`` (the collector's merged stream; every exporter
+ships a metrics snapshot event every couple of seconds) and renders
+one row per fleet process: step rate and p50, exchange / RPC p99s,
+decode queue depth and overload count, exporter drop counter, and
+restart counters — the "is the fleet healthy and busy" question at a
+glance, without ssh-ing into K processes to read K files.
+
+Step RATES are derived from consecutive snapshots of each process's
+``step_ms`` count (the snapshot itself only carries totals), so the
+first frame shows dashes until a second snapshot lands.
+
+Usage:
+    python tools/tmtop.py RUNDIR_OR_FLEET_JSONL [--interval 2]
+    python tools/tmtop.py RUNDIR --once        # one frame (tests/CI)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _fleet_path(target: str) -> str:
+    if os.path.isdir(target):
+        return os.path.join(target, "fleet.jsonl")
+    return target
+
+
+def read_records(path: str, offset: int = 0) -> tuple[list[dict], int]:
+    """Records after byte ``offset``; returns (records, new offset).
+    Restarts from 0 when the file shrank (rotation)."""
+    out: list[dict] = []
+    try:
+        size = os.path.getsize(path)
+        if size < offset:
+            offset = 0  # rotated under us
+        with open(path, encoding="utf-8") as f:
+            f.seek(offset)
+            for line in f:
+                if not line.endswith("\n"):
+                    break  # torn tail; re-read next frame
+                offset += len(line.encode("utf-8"))
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out, offset
+
+
+class Fleet:
+    """Latest metrics snapshot per process + step-rate deltas."""
+
+    def __init__(self):
+        self.latest: dict[tuple, dict] = {}
+        self.prev_steps: dict[tuple, tuple[float, float]] = {}
+        self.rates: dict[tuple, float] = {}
+
+    def feed(self, records: list[dict]) -> None:
+        for r in records:
+            if r.get("event") != "metrics":
+                continue
+            key = (r.get("role"), r.get("pid"))
+            self.latest[key] = r
+            count = sum(
+                s.get("count") or 0 for s in r.get("snapshot", [])
+                if s.get("name") == "step_ms")
+            ts = float(r.get("t_wall") or 0.0)
+            prev = self.prev_steps.get(key)
+            if prev is not None and ts > prev[0]:
+                self.rates[key] = (count - prev[1]) / (ts - prev[0])
+            self.prev_steps[key] = (ts, count)
+
+    def rows(self) -> list[dict]:
+        out = []
+        for (role, pid), rec in sorted(self.latest.items(),
+                                       key=lambda kv: str(kv[0])):
+            snap = rec.get("snapshot", [])
+
+            def series(name, field, agg=max, default=None):
+                vals = [s.get(field) for s in snap
+                        if s.get("name") == name
+                        and s.get(field) is not None]
+                return agg(vals) if vals else default
+
+            out.append({
+                "role": role, "pid": pid, "rank": rec.get("rank"),
+                "age_s": time.time() - float(rec.get("t_wall") or 0),
+                "rate": self.rates.get((role, pid)),
+                "step_p50": series("step_ms", "p50"),
+                "exch_p99": series("exchange_ms", "p99")
+                or series("span_ms", "p99"),
+                "rpc_p99": series("service/rpc_ms", "p99")
+                or series("service/client_rpc_ms", "p99")
+                or series("rpc/handshake_ms", "p99"),
+                "queue": series("decode/pending", "value", agg=sum)
+                or series("serving/queue_depth", "value", agg=sum),
+                "overload": series("decode/overloaded_total", "value",
+                                   agg=sum),
+                "drops": series("monitor/export_dropped_total",
+                                "value", agg=sum, default=0),
+                "restarts": (series("service/shard_restarts_total",
+                                    "value", agg=sum, default=0) or 0)
+                + (series("monitor/collector_restarts_total",
+                          "value", agg=sum, default=0) or 0),
+            })
+        return out
+
+
+def _fmt(v, spec="{:.1f}") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return spec.format(v)
+    return str(v)
+
+
+def render(rows: list[dict], path: str, file=None) -> None:
+    file = file if file is not None else sys.stdout
+    cols = [("role", 18), ("pid", 7), ("rank", 4), ("age", 6),
+            ("step/s", 7), ("p50ms", 8), ("exch p99", 9),
+            ("rpc p99", 8), ("queue", 6), ("ovld", 5), ("drops", 6),
+            ("rst", 4)]
+    print(f"tmtop — {path} — {time.strftime('%H:%M:%S')} — "
+          f"{len(rows)} processes", file=file)
+    print(" ".join(f"{name:>{w}}" for name, w in cols), file=file)
+    for r in rows:
+        vals = [str(r["role"])[:18], _fmt(r["pid"], "{}"),
+                _fmt(r["rank"], "{}"), _fmt(r["age_s"], "{:.0f}"),
+                _fmt(r["rate"], "{:.2f}"), _fmt(r["step_p50"]),
+                _fmt(r["exch_p99"]), _fmt(r["rpc_p99"]),
+                _fmt(r["queue"], "{:.0f}"),
+                _fmt(r["overload"], "{:.0f}"),
+                _fmt(r["drops"], "{:.0f}"),
+                _fmt(r["restarts"], "{:.0f}")]
+        print(" ".join(f"{v:>{w}}" for v, (_, w) in zip(vals, cols)),
+              file=file)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live fleet view over the telemetry collector's "
+                    "merged JSONL (docs/OBSERVABILITY.md)")
+    ap.add_argument("target", help="fleet.jsonl or the run dir")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (CI/tests)")
+    args = ap.parse_args(argv)
+
+    path = _fleet_path(args.target)
+    fleet = Fleet()
+    offset = 0
+    while True:
+        records, offset = read_records(path, offset)
+        fleet.feed(records)
+        if not args.once:
+            print("\x1b[2J\x1b[H", end="")
+        render(fleet.rows(), path)
+        if args.once:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `tmtop.py ... | head` is a normal use
+        sys.exit(0)
