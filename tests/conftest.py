@@ -64,7 +64,7 @@ def pytest_collection_modifyitems(config, items):
 _REPO_THREAD_NAMES = ("-exchange-", "serving-batcher-",
                       "serving-reload-watcher", "monitor-heartbeat-",
                       "monitor-export", "collector-watcher",
-                      "ingest-", "decode-", "rpc-")
+                      "ingest-", "decode-", "rpc-", "frontdoor-")
 #: library pools that are non-daemon BY DESIGN and process-lived
 #: (concurrent.futures executors inside jax/orbax) — not leaks
 _POOL_THREAD_PREFIXES = ("ThreadPoolExecutor", "asyncio_", "grpc",

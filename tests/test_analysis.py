@@ -153,7 +153,7 @@ def test_inventory_reflects_repo_emissions():
     sites = {f.site for f in site_coverage.collect_fires(files)}
     assert {"worker_step", "service_call", "exchange", "checkpoint",
             "serve_step", "serve_rpc", "decode_step", "ingest_batch",
-            "ingest_pull"} == sites
+            "ingest_pull", "router_route", "page_migrate"} == sites
 
 
 # ---------------------------------------------------------------------------

@@ -549,3 +549,34 @@ class TestTmtop:
         # (30-10 steps) / 2s = 10 steps/s, from consecutive snapshots
         assert "10.00" in out
         assert "12.5" in out  # step p50 ms
+
+    def test_fleet_role_column(self, tmp_path, capsys):
+        import tmtop
+
+        # exporter-name prefix -> fleet role; service{pid} must NOT
+        # read as a serving replica, unknown names fall back to train
+        assert tmtop.fleet_of("router123") == "router"
+        assert tmtop.fleet_of("prefill45") == "prefill"
+        assert tmtop.fleet_of("serve67") == "serve"
+        assert tmtop.fleet_of("service99") == "service"
+        assert tmtop.fleet_of("ingest_reader0_89") == "ingest"
+        assert tmtop.fleet_of("rank0") == "train"
+        assert tmtop.fleet_of(None) == "train"
+
+        def metrics(role, pid):
+            return {"event": "metrics", "t_wall": 100.0, "role": role,
+                    "pid": pid, "rank": None,
+                    "snapshot": [{"name": "step_ms",
+                                  "kind": "histogram", "labels": {},
+                                  "count": 1, "p50": 1.0, "p99": 2.0}]}
+
+        path = tmp_path / "fleet.jsonl"
+        path.write_text("".join(
+            json.dumps(metrics(r, p)) + "\n"
+            for r, p in (("router1", 1), ("prefill2", 2),
+                         ("serve3", 3))))
+        assert tmtop.main([str(tmp_path), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet" in out and "3 processes" in out
+        rows = {ln.split()[1] for ln in out.splitlines()[2:] if ln}
+        assert rows == {"router", "prefill", "serve"}

@@ -38,6 +38,10 @@ from theanompi_tpu.decode.kvcache import (
     PagePool,
     PrefixCache,
 )
+from theanompi_tpu.decode.migrate import (
+    IncompatiblePages,
+    page_manifest,
+)
 from theanompi_tpu.decode.model import full_forward
 from theanompi_tpu.decode.scheduler import (
     ContinuousBatcher,
@@ -52,5 +56,6 @@ from theanompi_tpu.decode.session import (
 __all__ = [
     "CacheConfig", "PagePool", "PrefixCache", "full_forward",
     "ContinuousBatcher", "DecodePolicy", "DecodeReplica",
-    "DecodeSession", "default_prefill_buckets",
+    "DecodeSession", "IncompatiblePages", "default_prefill_buckets",
+    "page_manifest",
 ]

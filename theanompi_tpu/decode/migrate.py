@@ -1,0 +1,119 @@
+"""KV-page migration contract — manifests + the typed refusal.
+
+Disaggregated serving (``theanompi_tpu/frontdoor``) splits the two
+phases of one generation stream across processes: a PREFILL replica
+runs the compute-bound prompt pass, then the filled KV pages travel to
+a DECODE replica as raw wire-v2 frames and the stream continues there
+token by token.  The bytes on the wire are just the sequence's slice
+of the page pool — ``(n_layers, pages_per_seq, page_size, n_heads,
+d_head)`` per pool, the exact ring layout ``DecodeSession._prefill_fn``
+scattered — so adoption on the receiver is one fixed-shape scatter
+(``DecodeSession.adopt_pages``) and steady state stays zero-recompile.
+
+That only works when both ends agree on the pool geometry, which is
+what the **page manifest** pins: every geometry field of the sender's
+:class:`~theanompi_tpu.decode.kvcache.CacheConfig` plus the stream's
+position state (prompt, length, the first generated token).  The
+receiver validates the manifest AND the arrays against its own config
+before touching its pool; any mismatch raises the typed
+:class:`IncompatiblePages` — a REFUSAL that rides the wire's ``err``
+prefix like ``Overloaded``/``IncompatibleExport``, fails only that
+stream, and leaves the replica and the connection serving
+(tests/test_frontdoor.py pins the whole matrix).
+
+Model-version skew between sender and receiver is tolerated, not
+refused: hot reload already lets an in-flight sequence continue on
+newer weights (docs/SERVING.md decode reload note), and migration is
+the same situation with the phases in different processes.  The
+manifest carries the sender's version purely for observability.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from theanompi_tpu.decode.kvcache import CacheConfig
+
+#: manifest fields that must equal the receiver's CacheConfig field of
+#: the same name — the pool-geometry contract
+GEOMETRY_FIELDS = ("n_layers", "n_heads", "d_head", "page_size",
+                   "pages_per_seq", "dtype")
+
+
+class IncompatiblePages(RuntimeError):
+    """Migrated KV pages refused: the manifest (or the page arrays
+    themselves) do not fit the receiving replica's cache geometry.
+    Typed so it rides the RPC ``err`` prefix and the client re-raises
+    it as itself — a per-stream refusal, never a replica failure."""
+
+
+def page_manifest(cfg: CacheConfig, prompt, length: int,
+                  first_token: int, version: int = 0) -> dict:
+    """The sender-side description of one prefilled stream's pages.
+
+    ``prompt`` is carried whole — it is the router's failover seed (a
+    dead decode replica means re-prefilling from the prompt) and the
+    receiver's prefix-cache key; ``first_token`` is the prefill
+    logits' argmax, emitted by the receiver so the adopted stream's
+    output is byte-identical to a local admit.
+    """
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    return {
+        "n_layers": int(cfg.n_layers),
+        "n_heads": int(cfg.n_heads),
+        "d_head": int(cfg.d_head),
+        "page_size": int(cfg.page_size),
+        "pages_per_seq": int(cfg.pages_per_seq),
+        "dtype": str(cfg.dtype),
+        "length": int(length),
+        "prompt": [int(t) for t in prompt],
+        "first_token": int(first_token),
+        "version": int(version),
+    }
+
+
+def manifest_incompatibility(manifest: dict,
+                             cfg: CacheConfig) -> str | None:
+    """Why ``manifest`` cannot be adopted into a pool shaped by
+    ``cfg`` — None when compatible.  Pure check, shared by the session
+    (before allocating pages) and tests (the refusal matrix)."""
+    if not isinstance(manifest, dict):
+        return f"manifest is {type(manifest).__name__}, not a dict"
+    for f in (*GEOMETRY_FIELDS, "length", "prompt", "first_token"):
+        if f not in manifest:
+            return f"manifest missing field {f!r}"
+    for f in GEOMETRY_FIELDS:
+        want = getattr(cfg, f)
+        got = manifest[f]
+        if (str(got) if f == "dtype" else int(got)) != \
+                (str(want) if f == "dtype" else int(want)):
+            return (f"page geometry mismatch on {f}: sender {got!r} "
+                    f"vs receiver {want!r}")
+    length = int(manifest["length"])
+    if length < 1:
+        return f"manifest length {length} < 1"
+    if len(manifest["prompt"]) != length:
+        return (f"manifest prompt has {len(manifest['prompt'])} "
+                f"tokens but length says {length}")
+    return None
+
+
+def pages_incompatibility(manifest: dict, k: np.ndarray, v: np.ndarray,
+                          cfg: CacheConfig) -> str | None:
+    """Full receiver-side check: the manifest against ``cfg`` AND the
+    page arrays against the shape/dtype the manifest promises (a
+    manifest can lie — the arrays travel as separate raw frames)."""
+    reason = manifest_incompatibility(manifest, cfg)
+    if reason is not None:
+        return reason
+    shape = (cfg.n_layers, cfg.pages_per_seq, cfg.page_size,
+             cfg.n_heads, cfg.d_head)
+    for name, arr in (("k", k), ("v", v)):
+        arr = np.asarray(arr)
+        if tuple(arr.shape) != shape:
+            return (f"{name} pages shaped {tuple(arr.shape)}, "
+                    f"receiver pool wants {shape}")
+        if str(arr.dtype) != str(np.dtype(cfg.dtype)):
+            return (f"{name} pages dtype {arr.dtype}, receiver pool "
+                    f"wants {np.dtype(cfg.dtype)}")
+    return None

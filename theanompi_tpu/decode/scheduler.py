@@ -55,6 +55,10 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
+from theanompi_tpu.decode.migrate import (
+    IncompatiblePages,
+    pages_incompatibility,
+)
 from theanompi_tpu.monitor import trace
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.serving.batcher import Overloaded
@@ -84,11 +88,16 @@ class DecodePolicy:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "out", "done", "error", "t0",
-                 "t_last", "cancelled")
+                 "t_last", "cancelled", "adopted")
 
-    def __init__(self, prompt: np.ndarray, max_new: int):
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 adopted: tuple | None = None):
         self.prompt = prompt
         self.max_new = int(max_new)
+        #: page migration (decode/migrate.py): ``(manifest, k, v)``
+        #: when this stream was prefilled elsewhere — admission adopts
+        #: the pages instead of running a local prefill
+        self.adopted = adopted
         self.out: list[int] = []
         self.done = threading.Event()
         self.error: BaseException | None = None
@@ -152,6 +161,10 @@ class ContinuousBatcher:
         #: step kept; emitted tokens ride the ordinary token counters
         self.n_drafted = 0
         self.n_draft_accepted = 0
+        #: page migration (disaggregated serving): streams whose
+        #: prefill arrived as wire frames / typed-refused manifests
+        self.n_adopted = 0
+        self.n_adopt_refused = 0
         #: last-seen cow_copies across both sessions (delta -> monitor)
         self._cow_seen = 0
         self._intertoken_ms: deque[float] = deque(maxlen=4096)  # guarded_by: self._lock
@@ -179,6 +192,13 @@ class ContinuousBatcher:
         with self._lock:
             return not self._dead and not self._stop.is_set()
 
+    def reset_intertoken(self) -> None:
+        """Drop the inter-token latency ring (bench seam: a warm pass
+        compiles programs, and those multi-second gaps would otherwise
+        sit in the measured pass's p99)."""
+        with self._lock:
+            self._intertoken_ms.clear()
+
     def stats(self) -> dict:
         from theanompi_tpu.utils.token_accounting import (
             speculative_accounting,
@@ -205,6 +225,8 @@ class ContinuousBatcher:
             "step_errors": self.n_step_errors,
             "shared_steps": self.shared_steps,
             "max_concurrent": self.max_concurrent,
+            "adopted": self.n_adopted,
+            "adopt_refused": self.n_adopt_refused,
             "active": len(self._active),
             "pending": pending,
             "free_pages": self.session.pool.free_pages,
@@ -298,6 +320,80 @@ class ContinuousBatcher:
             raise req.error
         return req.out
 
+    def generate_adopted(self, manifest: dict, k, v,
+                         max_new: int | None = None) -> list[int]:
+        """Adopt a migrated prefill (decode/migrate.py) and greedy-
+        decode up to ``max_new`` further tokens.  The manifest's
+        ``first_token`` (the sender's prefill argmax) is emitted as
+        token 0, so the stream's output is byte-identical to
+        :meth:`generate` over the same prompt on one replica.  Raises
+        the typed :class:`IncompatiblePages` when the pages don't fit
+        this replica's pool — a per-stream refusal, the replica and
+        the connection keep serving — and :class:`Overloaded` on
+        admission rejection, exactly like :meth:`generate`."""
+        if trace.enabled():
+            with monitor.span("decode_generate", replica=self.replica):
+                return self._generate_adopted(manifest, k, v, max_new)
+        return self._generate_adopted(manifest, k, v, max_new)
+
+    def _generate_adopted(self, manifest, k, v,
+                          max_new: int | None = None) -> list[int]:
+        faults.fire("page_migrate", side="adopt", replica=self.replica)
+        # geometry refusal BEFORE enqueue: a stream that can never be
+        # adopted must not occupy a pending slot (O(1), no data copy)
+        reason = pages_incompatibility(manifest, k, v,
+                                       self.session.cfg)
+        if reason is not None:
+            self.n_adopt_refused += 1
+            monitor.inc("decode/adopt_refused_total",
+                        replica=self.replica)
+            raise IncompatiblePages(reason)
+        max_new = int(max_new if max_new is not None
+                      else self.policy.max_new_cap)
+        max_new = min(max_new, self.policy.max_new_cap)
+        if max_new < 1:
+            raise ValueError("need max_new >= 1")
+        length = int(manifest["length"])
+        if length + max_new > self.session.max_len:
+            raise ValueError(
+                f"adopted length+max_new {length + max_new} exceeds "
+                f"the model's max_len {self.session.max_len} "
+                "(positional table)")
+        prompt = np.asarray(manifest["prompt"], np.int32).reshape(-1)
+        req = _GenRequest(prompt, max_new, adopted=(manifest, k, v))
+        with self._cond:
+            if self._dead or self._stop.is_set():
+                self.n_overloaded += 1
+                monitor.inc("decode/overloaded_total",
+                            replica=self.replica)
+                raise Overloaded(
+                    f"decode replica {self.replica} is not serving")
+            if len(self._pending) >= self.policy.max_pending:
+                self.n_overloaded += 1
+                monitor.inc("decode/overloaded_total",
+                            replica=self.replica)
+                raise Overloaded(
+                    f"decode replica {self.replica} admission queue is "
+                    f"full ({self.policy.max_pending} pending); "
+                    "rejecting instead of queueing unboundedly")
+            self._pending.append(req)
+            monitor.set_gauge("decode/pending", len(self._pending),
+                              replica=self.replica)
+            self._cond.notify_all()
+        if not req.done.wait(self.policy.submit_timeout_s):
+            with self._cond:
+                try:
+                    self._pending.remove(req)
+                except ValueError:
+                    req.cancelled = True
+            raise TimeoutError(
+                f"generate_adopted timed out after "
+                f"{self.policy.submit_timeout_s}s on decode replica "
+                f"{self.replica}")
+        if req.error is not None:
+            raise req.error
+        return req.out
+
     # -- scheduler thread ----------------------------------------------
 
     def _loop(self) -> None:
@@ -339,6 +435,10 @@ class ContinuousBatcher:
             if req is None:
                 return
             if req.cancelled:
+                continue
+            if req.adopted is not None:
+                if not self._admit_adopted(req):
+                    return
                 continue
             t0 = time.monotonic()
             h0, m0, e0 = self._prefix_metrics()
@@ -387,6 +487,57 @@ class ContinuousBatcher:
                           replica=self.replica)
         monitor.set_gauge("decode/active_seqs", len(self._active),
                           replica=self.replica)
+
+    def _admit_adopted(self, req: _GenRequest) -> bool:
+        """Admission for a migrated stream (decode/migrate.py): the
+        shipped pages scatter into the pool instead of running a local
+        prefill, and the sender's first token is emitted verbatim.
+        Returns False only when the poisoned-device path ran
+        (``_abort_inflight``) and the admit loop must stop."""
+        manifest, kp, vp = req.adopted
+        t0 = time.monotonic()
+        try:
+            seq = self.session.adopt_pages(manifest, kp, vp)
+        except (IncompatiblePages, ValueError) as e:
+            # per-stream refusal — the replica (and its connection)
+            # keeps serving; geometry was pre-checked at submit, so
+            # this only fires on races like a mid-flight hot reload
+            self.n_adopt_refused += 1
+            monitor.inc("decode/adopt_refused_total",
+                        replica=self.replica)
+            self._fail_requests([req], e)
+            return True
+        except Exception as e:
+            self._abort_inflight(e, extra=[req])
+            return False
+        dseq = None
+        if self._draft is not None:
+            # the draft is small and prefills the prompt locally — the
+            # TARGET's prefill is what migration offloads
+            try:
+                dseq, _ = self._draft.admit(req.prompt)
+            except Exception as e:
+                self.session.release(seq)
+                if isinstance(e, ValueError):
+                    self._fail_requests([req], e)
+                    return True
+                self._abort_inflight(e, extra=[req])
+                return False
+        monitor.observe("decode/adopt_ms",
+                        (time.monotonic() - t0) * 1e3,
+                        replica=self.replica)
+        self.n_adopted += 1
+        monitor.inc("decode/pages_adopted_total",
+                    self.session.cfg.pages_per_seq,
+                    replica=self.replica)
+        self.n_admitted += 1
+        monitor.inc("decode/admitted_total", replica=self.replica)
+        self._active.append((req, seq, dseq))
+        self.max_concurrent = max(self.max_concurrent,
+                                  len(self._active))
+        self._emit_token(req, int(manifest["first_token"]))
+        self._evict_finished()
+        return True
 
     def _step(self) -> None:
         if self._draft is not None:
@@ -679,6 +830,10 @@ class DecodeReplica:
 
     def generate(self, prompt, max_new: int | None = None) -> list[int]:
         return self.batcher.generate(prompt, max_new)
+
+    def generate_adopted(self, manifest: dict, k, v,
+                         max_new: int | None = None) -> list[int]:
+        return self.batcher.generate_adopted(manifest, k, v, max_new)
 
     def swap(self, version: int, params, model_state=None) -> None:
         self.session.swap(version, params, model_state)

@@ -173,7 +173,7 @@ class DecodeSession:
         #: the jitted bodies; the steady-state-zero-recompiles pin
         self.compiles = {"prefill": 0, "decode": 0, "verify": 0,
                          "propose": 0, "commit": 0, "extend": 0,
-                         "cow_copy": 0}
+                         "cow_copy": 0, "adopt": 0}
         self._prefill = jax.jit(
             self._prefill_fn, donate_argnums=(1, 2) if donate else ())
         self._decode = jax.jit(
@@ -190,6 +190,11 @@ class DecodeSession:
             self._extend_fn, donate_argnums=(1, 2) if donate else ())
         self._copy = jax.jit(
             self._copy_fn, donate_argnums=(0, 1) if donate else ())
+        # migrated pages arrive host-side (wire frames) and must
+        # survive a failed scatter for the refusal path — only the
+        # pool is donated
+        self._adopt = jax.jit(
+            self._adopt_fn, donate_argnums=(0, 1) if donate else ())
 
     # -- params ---------------------------------------------------------
 
@@ -392,6 +397,18 @@ class DecodeSession:
         self.compiles["cow_copy"] += 1     # trace-time counter
         k_pages = k_pages.at[:, dst].set(k_pages[:, src], mode="drop")
         v_pages = v_pages.at[:, dst].set(v_pages[:, src], mode="drop")
+        return k_pages, v_pages
+
+    def _adopt_fn(self, k_pages, v_pages, k_new, v_new, page_row):
+        """Page migration (decode/migrate.py): scatter one migrated
+        sequence's pages — ``(layers, pages_per_seq, page_size, heads,
+        d_head)`` per pool, the prefill ring layout verbatim — into
+        freshly allocated pages.  Fixed shape (always a full page row),
+        so the program compiles ONCE ever and a disaggregated decode
+        replica's steady state stays recompile-free."""
+        self.compiles["adopt"] += 1        # trace-time counter
+        k_pages = k_pages.at[:, page_row].set(k_new, mode="drop")
+        v_pages = v_pages.at[:, page_row].set(v_new, mode="drop")
         return k_pages, v_pages
 
     # -- scheduler-facing host API (single scheduler thread) ------------
@@ -653,6 +670,51 @@ class DecodeSession:
     def release(self, seq: _Seq) -> None:
         self.pool.free_seq(seq.page_row)
 
+    # -- page migration (decode/migrate.py; frontdoor plane) ------------
+
+    def export_pages(self, seq: _Seq) -> tuple[np.ndarray, np.ndarray]:
+        """One sequence's KV pages as host arrays, ring layout
+        verbatim: ``(n_layers, pages_per_seq, page_size, n_heads,
+        d_head)`` per pool — the wire payload of a prefill→decode
+        migration.  Read-only (shared/prefix-cache pages export the
+        same bytes a local reader would see); call BEFORE release."""
+        rows = jnp.asarray(seq.page_row)
+        k, v = jax.device_get((self._ck[:, rows], self._cv[:, rows]))
+        return np.asarray(k), np.asarray(v)
+
+    def adopt_pages(self, manifest: dict, k: np.ndarray,
+                    v: np.ndarray) -> _Seq:
+        """Adopt a migrated sequence: validate the manifest + arrays
+        against THIS pool's geometry (typed
+        :class:`~theanompi_tpu.decode.migrate.IncompatiblePages` on any
+        mismatch — a per-stream refusal, the replica keeps serving),
+        allocate a fresh page row, scatter the pages in with the
+        fixed-shape adopt program, and register the prompt's prefixes
+        in the prefix cache exactly like a local admit."""
+        from theanompi_tpu.decode import migrate
+
+        reason = migrate.pages_incompatibility(manifest, k, v, self.cfg)
+        if reason is not None:
+            raise migrate.IncompatiblePages(reason)
+        got = self._alloc_pages(self.cfg.pages_per_seq)
+        if got is None:
+            raise RuntimeError(
+                "adopt_pages() without free pages — the scheduler "
+                "must check can_admit() first")
+        page_row = np.asarray(got, np.int32)
+        try:
+            self._ck, self._cv = self._adopt(
+                self._ck, self._cv, jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(page_row))
+        except Exception:
+            # a failed scatter must not leak the sequence's pages
+            self.pool.free_seq(page_row)
+            raise
+        prompt = np.asarray(manifest["prompt"], np.int32)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(prompt, page_row)
+        return _Seq(page_row, int(manifest["length"]))
+
     def reset_cache(self) -> None:
         """Fresh page pool + allocator (restart-from-export path): a
         failed step may have consumed the donated pool buffers, so the
@@ -694,6 +756,14 @@ class DecodeSession:
                 self._ck, self._cv,
                 jnp.zeros((COPY_BUCKET,), jnp.int32),
                 jnp.full((COPY_BUCKET,), self.cfg.n_pages, jnp.int32))
+        # the adopt scatter (page migration) is one fixed shape — warm
+        # it here so a disaggregated replica's first migrated stream
+        # never stalls a neighbor's intertoken SLO on a compile
+        z = jnp.zeros((self.n_layers, self.cfg.pages_per_seq,
+                       self.cfg.page_size, self.n_heads,
+                       self.cfg.d_head), self.dtype)
+        self._ck, self._cv = self._adopt(self._ck, self._cv, z, z,
+                                         jnp.asarray(drop_row))
 
     def warmup_spec(self, k: int, role: str) -> None:
         """Compile the speculative programs for the smallest decode

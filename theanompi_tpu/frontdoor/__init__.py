@@ -1,0 +1,30 @@
+"""Disaggregated serving front door (docs/SERVING.md).
+
+Three roles over the shared RPC substrate: a PREFILL fleet running
+only the prompt-phase programs (``frontdoor/prefill.py``), the
+existing decode-mode servers adopting migrated KV pages
+(``decode/migrate.py`` + the ``adopt`` op), and a mux-native ROUTER
+(``frontdoor/router.py``) splitting each client stream across them —
+plus a signal-driven autoscaler (``frontdoor/autoscale.py``) growing
+and shrinking each role without dropping a stream.
+"""
+
+from theanompi_tpu.frontdoor.autoscale import (
+    Autoscaler,
+    HysteresisController,
+    RoleGroup,
+)
+from theanompi_tpu.frontdoor.fleet import DisaggregatedFleet
+from theanompi_tpu.frontdoor.prefill import PrefillClient, PrefillServer
+from theanompi_tpu.frontdoor.router import Router, RouterClient
+
+__all__ = [
+    "Autoscaler",
+    "DisaggregatedFleet",
+    "HysteresisController",
+    "PrefillClient",
+    "PrefillServer",
+    "RoleGroup",
+    "Router",
+    "RouterClient",
+]

@@ -1,0 +1,445 @@
+"""Signal-driven autoscaling for the disaggregated serving fleet.
+
+Three layers, separable on purpose:
+
+* :class:`HysteresisController` — the pure decision unit: a normalized
+  load signal in, ``+1 / -1 / 0`` out.  Hysteresis (distinct up/down
+  thresholds), a consecutive-breach hold (one hot poll never scales),
+  and a post-event cooldown (no flapping) — all against an injected
+  clock, so the unit tests drive time instead of sleeping through it.
+* :class:`RoleGroup` — one role's supervised process group, the
+  ``IngestProcessGroup`` pattern: real subprocesses on free local
+  ports, a watcher thread that relaunches a dead replica on its port
+  within a restart budget, and intentional removals (scale-down)
+  excluded from supervision so a drained replica stays dead.
+* :class:`Autoscaler` — the loop: polls each role's replicas for the
+  signals they already emit (queue depth, page-pool occupancy,
+  intertoken p99, overload counts), folds them into one load scalar
+  per role, asks the controller, and executes the decision against the
+  router's backend set.
+
+Scale events drop nothing, by construction: scale-UP spawns the
+replica, waits until it answers, and only then adds it to the router
+(new traffic lands on a warm replica); scale-DOWN removes the backend
+from the router FIRST (drain — no new streams route to it), waits for
+the router to report zero in-flight streams on it, and only then kills
+the process (tests/test_frontdoor.py pins both directions).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class HysteresisController:
+    """Pure scale decision: hysteresis + breach hold + cooldown.
+
+    ``decide(load, size)`` returns ``+1`` (grow), ``-1`` (shrink) or
+    ``0``.  A decision needs ``hold`` CONSECUTIVE polls breaching the
+    same threshold, at least ``cooldown_s`` since the last event, and
+    room inside ``[min_size, max_size]``.  Loads between the two
+    thresholds reset both breach counters — the dead band is what
+    keeps a noisy signal from sawtoothing the fleet."""
+
+    def __init__(self, up: float = 0.8, down: float = 0.2,
+                 hold: int = 2, cooldown_s: float = 10.0,
+                 min_size: int = 1, max_size: int = 4,
+                 clock=time.monotonic):
+        if not down < up:
+            raise ValueError(f"need down < up, got {down} >= {up}")
+        if not 1 <= min_size <= max_size:
+            raise ValueError(f"need 1 <= min_size <= max_size, got "
+                             f"[{min_size}, {max_size}]")
+        self.up = float(up)
+        self.down = float(down)
+        self.hold = int(hold)
+        self.cooldown_s = float(cooldown_s)
+        self.min_size = int(min_size)
+        self.max_size = int(max_size)
+        self._clock = clock
+        self._above = 0
+        self._below = 0
+        self._last_event: float | None = None
+
+    def decide(self, load: float, size: int) -> int:
+        load = float(load)
+        if load >= self.up:
+            self._above += 1
+            self._below = 0
+        elif load <= self.down:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = self._below = 0
+        if (self._last_event is not None
+                and self._clock() - self._last_event < self.cooldown_s):
+            return 0
+        if self._above >= self.hold and size < self.max_size:
+            self._above = 0
+            self._last_event = self._clock()
+            return 1
+        if self._below >= self.hold and size > self.min_size:
+            self._below = 0
+            self._last_event = self._clock()
+            return -1
+        return 0
+
+
+class RoleGroup:
+    """One role's supervised process group (module docstring).
+
+    ``spawn_argv(port)`` builds the child's argv; every child inherits
+    the environment (the shared ``THEANOMPI_TPU_SERVICE_KEY``, monitor
+    and collector settings).  ``probe(addr)`` answers whether the
+    replica at ``addr`` serves — default: one ``ping`` RPC."""
+
+    def __init__(self, role: str, spawn_argv, initial: int = 1,
+                 host: str = "127.0.0.1", max_restarts: int = 1,
+                 ready_timeout_s: float = 180.0, probe=None):
+        from theanompi_tpu.parallel.service import _authkey
+
+        _authkey(generate=True)  # ensure + export the shared key
+        self.role = str(role)
+        self.host = host
+        self.spawn_argv = spawn_argv
+        self.max_restarts = int(max_restarts)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self._probe_fn = probe or self._rpc_probe
+        self._lock = make_lock("frontdoor.RoleGroup._lock")
+        self._stopping = threading.Event()
+        self._procs: dict[int, subprocess.Popen] = {}  # guarded_by: self._lock
+        self._restarts: dict[int, int] = {}            # guarded_by: self._lock
+        for _ in range(int(initial)):
+            self.grow()
+        self._watcher = threading.Thread(
+            target=self._watch, daemon=True,
+            name=f"frontdoor-{self.role}-watcher")
+        self._watcher.start()
+
+    # -- addresses ------------------------------------------------------
+
+    def addresses(self) -> list[str]:
+        with self._lock:
+            ports = sorted(self._procs)
+        return [f"{self.host}:{p}" for p in ports]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._procs)
+
+    # -- probing --------------------------------------------------------
+
+    def _rpc_probe(self, addr: str) -> bool:
+        from theanompi_tpu.parallel.service import ServiceClient
+        from theanompi_tpu.resilience.retry import RetryPolicy
+
+        c = None
+        try:
+            c = ServiceClient(addr, retry=RetryPolicy(
+                max_attempts=1, name="frontdoor-probe"))
+            return c.call("ping") == "pong"
+        except Exception:
+            return False
+        finally:
+            if c is not None:
+                c.close()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def grow(self) -> str:
+        """Spawn one replica on a free port, wait until it serves,
+        return its address — the caller adds it to the router AFTER
+        this returns, so new traffic only ever lands on a warm one."""
+        port = _free_port()
+        proc = subprocess.Popen(self.spawn_argv(port),
+                                env=dict(os.environ))
+        addr = f"{self.host}:{port}"
+        deadline = time.monotonic() + self.ready_timeout_s
+        while not self._probe_fn(addr):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"frontdoor {self.role} replica died during "
+                    f"startup (rc={proc.returncode})")
+            if time.monotonic() > deadline:
+                proc.terminate()
+                raise RuntimeError(
+                    f"frontdoor {self.role} replica at {addr} never "
+                    f"came up within {self.ready_timeout_s}s")
+            time.sleep(0.3)
+        with self._lock:
+            self._procs[port] = proc
+        return addr
+
+    def release(self, addr: str) -> None:
+        """Kill one DRAINED replica intentionally (scale-down): it
+        leaves supervision first, so the watcher does not resurrect
+        what the autoscaler just removed."""
+        port = int(str(addr).rpartition(":")[2])
+        with self._lock:
+            proc = self._procs.pop(port, None)
+            self._restarts.pop(port, None)
+        if proc is None:
+            return
+        self._stop_proc(proc)
+
+    def kill(self, addr: str) -> None:
+        """Hard-kill one replica WITHOUT removing it from supervision
+        (fault drills: the watcher relaunches it on its port within
+        the restart budget)."""
+        port = int(str(addr).rpartition(":")[2])
+        with self._lock:
+            proc = self._procs.get(port)
+        if proc is not None:
+            proc.kill()
+
+    def _watch(self) -> None:
+        while not self._stopping.wait(0.5):
+            with self._lock:
+                procs = dict(self._procs)
+            for port, proc in procs.items():
+                if proc.poll() is None or self._stopping.is_set():
+                    continue
+                with self._lock:
+                    if self._procs.get(port) is not proc:
+                        continue  # released/replaced concurrently
+                    n = self._restarts.get(port, 0)
+                    if n >= self.max_restarts:
+                        continue  # budget spent: leave the corpse
+                    self._restarts[port] = n + 1
+                    self._procs[port] = subprocess.Popen(
+                        self.spawn_argv(port), env=dict(os.environ))
+                print(f"[frontdoor] {self.role} replica on port {port} "
+                      f"died (rc={proc.returncode}); relaunched "
+                      f"({n + 1}/{self.max_restarts})",
+                      file=sys.stderr, flush=True)
+                monitor.inc("frontdoor/replica_restarts_total",
+                            role=self.role)
+
+    def restart_counts(self) -> dict:
+        with self._lock:
+            return dict(self._restarts)
+
+    @staticmethod
+    def _stop_proc(proc: subprocess.Popen) -> None:
+        if proc.poll() is None:
+            proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5)
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._watcher.is_alive():
+            self._watcher.join(timeout=5)
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for p in procs:
+            self._stop_proc(p)
+
+
+class Autoscaler:
+    """The loop: poll signals, fold to a load scalar, act.
+
+    The load scalar per role is the MAX over that role's replicas of:
+
+    * queue depth — in-flight prefills / ``max_pending`` (prefill) or
+      pending generate requests / ``max_pending`` (decode);
+    * page-pool occupancy — ``1 - free_pages / n_pages`` (decode);
+    * overload rate — any typed ``Overloaded`` shed since the last
+      poll saturates the signal to 1.0 (shedding IS the queue being
+      full, whatever the gauges say);
+    * intertoken p99 vs. ``slo_p99_ms`` (decode, when an SLO is set).
+
+    MAX, not mean: one saturated replica is a reason to grow even when
+    its siblings idle — the router round-robins, so sustained skew
+    means the fleet, not the balance, is short."""
+
+    def __init__(self, router, groups: dict, controllers: dict,
+                 poll_s: float = 1.0, slo_p99_ms: float | None = None,
+                 drain_timeout_s: float = 30.0):
+        self.router = router
+        self.groups = dict(groups)
+        self.controllers = dict(controllers)
+        self.poll_s = float(poll_s)
+        self.slo_p99_ms = slo_p99_ms
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._lock = make_lock("frontdoor.Autoscaler._lock")
+        self._stopping = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._clients: dict = {}        # guarded_by: self._lock
+        self._last_overloaded: dict = {}  # guarded_by: self._lock
+        #: executed scale events [(role, direction, addr)] — the test
+        #: and bench evidence surface
+        self.events: list = []          # guarded_by: self._lock
+        for role, group in self.groups.items():
+            monitor.set_gauge("frontdoor/fleet_size", len(group),
+                              role=role)
+
+    # -- signal polling -------------------------------------------------
+
+    def _stats(self, addr: str) -> dict | None:
+        from theanompi_tpu.parallel.service import ServiceClient
+        from theanompi_tpu.resilience.retry import RetryPolicy
+
+        with self._lock:
+            client = self._clients.get(addr)
+        try:
+            if client is None:
+                client = ServiceClient(addr, retry=RetryPolicy(
+                    max_attempts=1, name="frontdoor-scale-stats"))
+                with self._lock:
+                    self._clients[addr] = client
+            return client.call("stats")
+        except Exception:
+            with self._lock:
+                self._clients.pop(addr, None)
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:
+                    pass
+            return None
+
+    def _overload_delta(self, addr: str, count: int) -> int:
+        with self._lock:
+            prev = self._last_overloaded.get(addr, count)
+            self._last_overloaded[addr] = count
+        return max(0, count - prev)
+
+    def _replica_load(self, addr: str, stats: dict) -> float:
+        load = 0.0
+        if stats.get("role") == "prefill":
+            cap = max(1, int(stats.get("max_pending", 1)))
+            load = max(load, float(stats.get("inflight", 0)) / cap)
+            shed = int(stats.get("overloaded", 0))
+        else:
+            # a decode-mode tmserver: fold its replicas' signals
+            shed = int(stats.get("overloaded", 0))
+            for rep in stats.get("replicas", []):
+                pend = float(rep.get("pending", 0))
+                load = max(load, pend / 8.0)
+                free = rep.get("free_pages")
+                active = float(rep.get("active", 0))
+                if free is not None:
+                    total = free + active * 8.0  # pages_per_seq bound
+                    if total > 0:
+                        load = max(load, 1.0 - free / total)
+                p99 = (rep.get("intertoken_ms") or {}).get("p99")
+                if self.slo_p99_ms and p99:
+                    load = max(load, float(p99) / float(self.slo_p99_ms))
+        if self._overload_delta(addr, shed) > 0:
+            load = max(load, 1.0)
+        return load
+
+    def role_load(self, role: str) -> float:
+        load = 0.0
+        for addr in self.groups[role].addresses():
+            stats = self._stats(addr)
+            if stats is None:
+                continue  # dead/booting replica: supervision's job
+            load = max(load, self._replica_load(addr, stats))
+        monitor.set_gauge("frontdoor/role_load", load, role=role)
+        return load
+
+    # -- acting ---------------------------------------------------------
+
+    def _scale_up(self, role: str) -> str:
+        group = self.groups[role]
+        addr = group.grow()
+        self.router.add_backend(role, addr)
+        with self._lock:
+            self.events.append((role, "up", addr))
+        monitor.inc("frontdoor/scale_events_total", role=role,
+                    direction="up")
+        monitor.set_gauge("frontdoor/fleet_size", len(group), role=role)
+        print(f"[frontdoor] scale-up {role} -> {len(group)} "
+              f"(added {addr})", flush=True)
+        return addr
+
+    def _scale_down(self, role: str) -> str | None:
+        group = self.groups[role]
+        addrs = group.addresses()
+        if len(addrs) <= 1:
+            return None
+        addr = addrs[-1]  # newest replica drains first
+        # drain FIRST: the router stops routing new streams to it,
+        # in-flight streams finish, and only a zero-stream backend dies
+        self.router.remove_backend(role, addr)
+        deadline = time.monotonic() + self.drain_timeout_s
+        while self.router.backend_streams(role, addr) > 0:
+            if time.monotonic() > deadline:
+                print(f"[frontdoor] scale-down {role} {addr}: drain "
+                      f"timed out after {self.drain_timeout_s}s; "
+                      "killing anyway", flush=True)
+                break
+            time.sleep(0.05)
+        group.release(addr)
+        with self._lock:
+            self.events.append((role, "down", addr))
+            self._clients.pop(addr, None)
+            self._last_overloaded.pop(addr, None)
+        monitor.inc("frontdoor/scale_events_total", role=role,
+                    direction="down")
+        monitor.set_gauge("frontdoor/fleet_size", len(group), role=role)
+        print(f"[frontdoor] scale-down {role} -> {len(group)} "
+              f"(drained {addr})", flush=True)
+        return addr
+
+    def tick(self) -> None:
+        """One poll → decide → act pass over every role."""
+        for role, controller in self.controllers.items():
+            decision = controller.decide(self.role_load(role),
+                                         len(self.groups[role]))
+            if decision > 0:
+                self._scale_up(role)
+            elif decision < 0:
+                self._scale_down(role)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="frontdoor-autoscaler")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stopping.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception as e:
+                # one bad poll (a replica mid-restart) must not kill
+                # the loop; next tick re-reads the world
+                print(f"[frontdoor] autoscaler tick failed: "
+                      f"{type(e).__name__}: {e}", flush=True)
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass

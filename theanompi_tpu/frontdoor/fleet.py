@@ -1,0 +1,272 @@
+"""Disaggregated serving fleet — spawn the roles, run the front door.
+
+:class:`DisaggregatedFleet` is the operator surface (``tmfront``,
+``tmlocal SERVE --decode --disaggregate``): it spawns the PREFILL
+fleet (``python -m theanompi_tpu.frontdoor.prefill`` per replica) and
+the DECODE fleet (``python -m theanompi_tpu.serving.server --decode``
+per replica — the same server binary a single-role deployment runs,
+now answering the ``adopt`` op) as supervised
+:class:`~theanompi_tpu.frontdoor.autoscale.RoleGroup` process groups,
+runs the :class:`~theanompi_tpu.frontdoor.router.Router` in-process
+behind the shared RPC substrate, and (optionally) starts the
+:class:`~theanompi_tpu.frontdoor.autoscale.Autoscaler` over both
+roles.
+
+Every child inherits the environment, so the shared
+``THEANOMPI_TPU_SERVICE_KEY``, the monitor dir, and a collector
+address fan out automatically — one ``tools/traces.py`` invocation
+stitches client → router → prefill → decode spans from the collector
+file the roles all ship to.
+
+Both role fleets MUST agree on page geometry (page size, pages per
+sequence, dtype follows the export): the router ships prefilled pages
+verbatim, and a decode replica refuses mismatched pages with the typed
+``IncompatiblePages``.  The fleet passes one set of knobs to both
+sides so a single deployment cannot disagree with itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+from theanompi_tpu import monitor
+from theanompi_tpu.frontdoor import router as router_mod
+from theanompi_tpu.frontdoor.autoscale import (
+    Autoscaler,
+    HysteresisController,
+    RoleGroup,
+    _free_port,
+)
+from theanompi_tpu.frontdoor.router import Router
+
+
+class DisaggregatedFleet:
+    """Prefill fleet + decode fleet + in-process router (+ autoscaler)."""
+
+    def __init__(self, export_dir: str, prefill: int = 1,
+                 decode: int = 1, host: str = "127.0.0.1",
+                 router_host: str = "0.0.0.0",
+                 router_port: int | None = None,
+                 max_streams: int = 64, failover_attempts: int = 2,
+                 page_size: int = 16, pages_per_seq: int = 8,
+                 max_seqs: int = 8,
+                 prefill_buckets: tuple[int, ...] | None = None,
+                 prefill_max_pending: int = 8,
+                 decode_max_pending: int = 32,
+                 prefix_cache: bool = True,
+                 draft_export_dir: str | None = None,
+                 speculate_k: int = 4, autoscale: bool = False,
+                 scale_min: int = 1, scale_max: int = 4,
+                 scale_poll_s: float = 1.0,
+                 slo_p99_ms: float | None = None,
+                 max_restarts: int = 1,
+                 ready_timeout_s: float = 180.0):
+        self.export_dir = os.path.abspath(export_dir)
+        self.host = host
+        self.page_size = int(page_size)
+        self.pages_per_seq = int(pages_per_seq)
+        self.max_seqs = int(max_seqs)
+        self.prefill_buckets = prefill_buckets
+        self.prefill_max_pending = int(prefill_max_pending)
+        self.decode_max_pending = int(decode_max_pending)
+        self.prefix_cache = bool(prefix_cache)
+        self.draft_export_dir = draft_export_dir
+        self.speculate_k = int(speculate_k)
+
+        self.prefill_group = RoleGroup(
+            "prefill", self._prefill_argv, initial=int(prefill),
+            host=host, max_restarts=max_restarts,
+            ready_timeout_s=ready_timeout_s)
+        try:
+            self.decode_group = RoleGroup(
+                "decode", self._decode_argv, initial=int(decode),
+                host=host, max_restarts=max_restarts,
+                ready_timeout_s=ready_timeout_s)
+        except BaseException:
+            self.prefill_group.stop()
+            raise
+
+        self.router = Router(prefill=self.prefill_group.addresses(),
+                             decode=self.decode_group.addresses(),
+                             max_streams=max_streams,
+                             failover_attempts=failover_attempts)
+        self.router_host = router_host
+        self.router_port = int(router_port or _free_port())
+        self._stop_serve = threading.Event()
+        ready = threading.Event()
+        self._serve_thread = threading.Thread(
+            target=router_mod.serve, daemon=True,
+            name="frontdoor-router",
+            kwargs=dict(router=self.router, host=router_host,
+                        port=self.router_port, ready_event=ready,
+                        stop_event=self._stop_serve))
+        self._serve_thread.start()
+        if not ready.wait(timeout=30):
+            self.stop()
+            raise RuntimeError("frontdoor router never bound its port")
+
+        self.autoscaler: Autoscaler | None = None
+        if autoscale:
+            groups = {"prefill": self.prefill_group,
+                      "decode": self.decode_group}
+            controllers = {
+                role: HysteresisController(min_size=int(scale_min),
+                                           max_size=int(scale_max))
+                for role in groups
+            }
+            self.autoscaler = Autoscaler(
+                self.router, groups, controllers,
+                poll_s=scale_poll_s, slo_p99_ms=slo_p99_ms).start()
+
+    # -- child argv -----------------------------------------------------
+
+    def _prefill_argv(self, port: int) -> list[str]:
+        cmd = [sys.executable, "-m", "theanompi_tpu.frontdoor.prefill",
+               "--export-dir", self.export_dir, "--host", self.host,
+               "--port", str(port),
+               "--page-size", str(self.page_size),
+               "--pages-per-seq", str(self.pages_per_seq),
+               "--max-seqs", str(self.max_seqs),
+               "--max-pending", str(self.prefill_max_pending)]
+        if self.prefill_buckets:
+            cmd += ["--prefill-buckets",
+                    ",".join(str(b) for b in self.prefill_buckets)]
+        if not self.prefix_cache:
+            cmd += ["--no-prefix-cache"]
+        return cmd
+
+    def _decode_argv(self, port: int) -> list[str]:
+        cmd = [sys.executable, "-m", "theanompi_tpu.serving.server",
+               "--export-dir", self.export_dir, "--host", self.host,
+               "--port", str(port), "--replicas", "1", "--decode",
+               "--decode-page-size", str(self.page_size),
+               "--decode-pages-per-seq", str(self.pages_per_seq),
+               "--decode-max-seqs", str(self.max_seqs),
+               "--decode-max-pending", str(self.decode_max_pending)]
+        if self.prefill_buckets:
+            cmd += ["--decode-prefill-buckets",
+                    ",".join(str(b) for b in self.prefill_buckets)]
+        if self.draft_export_dir:
+            cmd += ["--decode-draft-export-dir", self.draft_export_dir,
+                    "--decode-speculate-k", str(self.speculate_k)]
+        if not self.prefix_cache:
+            cmd += ["--decode-no-prefix-cache"]
+        return cmd
+
+    # -- surface --------------------------------------------------------
+
+    @property
+    def router_addr(self) -> str:
+        host = ("127.0.0.1" if self.router_host == "0.0.0.0"
+                else self.router_host)
+        return f"{host}:{self.router_port}"
+
+    def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self._stop_serve.set()
+        if self._serve_thread.is_alive():
+            self._serve_thread.join(timeout=10)
+        self.router.close()
+        self.decode_group.stop()
+        self.prefill_group.stop()
+
+    def __enter__(self) -> "DisaggregatedFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def run_foreground(**fleet_kwargs) -> int:
+    """Build a fleet and serve until interrupted — the shared body of
+    ``tmfront`` and ``tmlocal SERVE --decode --disaggregate``."""
+    with monitor.session(stall_after=float("inf"),
+                         name=f"router{os.getpid()}"):
+        monitor.progress(phase="frontdoor")
+        fleet = DisaggregatedFleet(**fleet_kwargs)
+        print(f"[frontdoor] fleet up — router at {fleet.router_addr} "
+              f"({len(fleet.prefill_group)} prefill / "
+              f"{len(fleet.decode_group)} decode, autoscale="
+              f"{'on' if fleet.autoscaler is not None else 'off'})",
+              flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            fleet.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="theanompi-tpu disaggregated serving fleet: "
+                    "prefill replicas + decode replicas + front-door "
+                    "router (docs/SERVING.md 'Disaggregated serving')")
+    ap.add_argument("--export-dir", required=True)
+    ap.add_argument("--prefill", type=int, default=1, metavar="N",
+                    help="initial prefill replica count")
+    ap.add_argument("--decode", type=int, default=1, metavar="N",
+                    help="initial decode replica count")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="backend bind/connect host")
+    ap.add_argument("--router-host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=router_mod.DEFAULT_PORT,
+                    help="router listen port (the client-facing one)")
+    ap.add_argument("--max-streams", type=int, default=64)
+    ap.add_argument("--failover-attempts", type=int, default=2)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--pages-per-seq", type=int, default=8)
+    ap.add_argument("--max-seqs", type=int, default=8)
+    ap.add_argument("--prefill-buckets", default=None, metavar="N,N,...")
+    ap.add_argument("--prefill-max-pending", type=int, default=8)
+    ap.add_argument("--decode-max-pending", type=int, default=32)
+    ap.add_argument("--no-prefix-cache", action="store_true")
+    ap.add_argument("--draft-export-dir", default=None, metavar="DIR",
+                    help="speculative decoding on the decode fleet")
+    ap.add_argument("--speculate-k", type=int, default=4)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="grow/shrink both roles from load signals "
+                         "(frontdoor/autoscale.py)")
+    ap.add_argument("--scale-min", type=int, default=1)
+    ap.add_argument("--scale-max", type=int, default=4,
+                    help="max replicas per role (the fleet budget)")
+    ap.add_argument("--scale-poll-s", type=float, default=1.0)
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="intertoken p99 target feeding the decode "
+                         "role's scale signal")
+    ap.add_argument("--max-restarts", type=int, default=1)
+    ap.add_argument("--platform", default=None,
+                    help="jax platform for the CHILD processes (e.g. "
+                         "'cpu'; exported via JAX_PLATFORMS)")
+    args = ap.parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
+               if args.prefill_buckets else None)
+    return run_foreground(
+        export_dir=args.export_dir, prefill=args.prefill,
+        decode=args.decode, host=args.host,
+        router_host=args.router_host, router_port=args.port,
+        max_streams=args.max_streams,
+        failover_attempts=args.failover_attempts,
+        page_size=args.page_size, pages_per_seq=args.pages_per_seq,
+        max_seqs=args.max_seqs, prefill_buckets=buckets,
+        prefill_max_pending=args.prefill_max_pending,
+        decode_max_pending=args.decode_max_pending,
+        prefix_cache=not args.no_prefix_cache,
+        draft_export_dir=args.draft_export_dir,
+        speculate_k=args.speculate_k, autoscale=args.autoscale,
+        scale_min=args.scale_min, scale_max=args.scale_max,
+        scale_poll_s=args.scale_poll_s, slo_p99_ms=args.slo_p99_ms,
+        max_restarts=args.max_restarts)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

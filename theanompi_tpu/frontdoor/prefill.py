@@ -1,0 +1,254 @@
+"""Prefill fleet — the compute-bound half of disaggregated serving.
+
+A :class:`PrefillServer` owns one :class:`~theanompi_tpu.decode.session
+.DecodeSession` and runs ONLY the prompt-phase programs: ``prefill``
+(and ``extend`` on a prefix-cache hit).  One ``prefill`` RPC admits the
+prompt, reads the first generated token off the prefill logits, exports
+the sequence's KV pages as host arrays (ring layout verbatim,
+``decode/migrate.py``), releases the pages back to the pool, and ships
+``(manifest, RawArrays(k, v))`` — the raw uint8 frame path, because KV
+bytes must arrive at the decode fleet EXACTLY as prefilled.
+
+The replica holds NO stream state across requests: pages live on it
+only for the duration of one RPC (the prefix cache keeps page-aligned
+prefixes hot across prompts, exactly like a decode replica's).  That is
+what makes the prefill role trivially scalable — the autoscaler
+(``frontdoor/autoscale.py``) can kill any prefill replica between RPCs
+without dropping a stream.
+
+Admission is a counter, not a queue: past ``max_pending`` concurrent
+prefills the RPC is refused with the typed
+:class:`~theanompi_tpu.serving.batcher.Overloaded` in O(1) — the
+router treats it as load-shedding and tries the next replica, never a
+destructive retry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+
+import numpy as np
+
+from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
+from theanompi_tpu.decode import migrate
+from theanompi_tpu.decode.session import DecodeSession
+from theanompi_tpu.parallel import rpc, wire
+from theanompi_tpu.parallel.service import ServiceClient, ServiceError
+from theanompi_tpu.resilience import faults
+from theanompi_tpu.serving.batcher import Overloaded
+from theanompi_tpu.serving.export import build_model_from_meta, load_export
+
+#: one above the serving block's 45900
+DEFAULT_PORT = 45950
+
+
+class PrefillServer:
+    """One prefill replica: prompt in, (manifest, KV pages) out."""
+
+    def __init__(self, export_dir: str, page_size: int = 16,
+                 pages_per_seq: int = 8, max_seqs: int = 8,
+                 prefill_buckets: tuple[int, ...] | None = None,
+                 max_pending: int = 8, warmup: bool = True,
+                 model=None, prefix_cache: bool = True):
+        self.export_dir = os.path.abspath(export_dir)
+        loaded = load_export(self.export_dir)
+        if not loaded.meta.get("decode"):
+            raise ValueError(
+                "the prefill role needs a decode-capable export "
+                "(TransformerLM family; export_meta 'decode' is "
+                f"false/absent in {self.export_dir})")
+        self.model = (model if model is not None
+                      else build_model_from_meta(loaded.meta))
+        self.session = DecodeSession(
+            self.model, params=loaded.params, version=loaded.version,
+            page_size=page_size, pages_per_seq=pages_per_seq,
+            max_seqs=max_seqs, prefill_buckets=prefill_buckets,
+            prefix_cache=prefix_cache)
+        self.max_pending = int(max_pending)
+        # the session's host-side state (pool, prefix cache, jit calls)
+        # is built for a single scheduler thread; RPC handlers are a
+        # pool, so one lock serializes the admit→export→release window
+        self._lock = make_lock("PrefillServer._lock")
+        self.n_prefills = 0        # guarded_by: self._lock
+        self._gate = make_lock("PrefillServer._gate")
+        self._inflight = 0         # guarded_by: self._gate
+        self.n_shed = 0            # guarded_by: self._gate
+        if warmup:
+            self.session.warmup()
+
+    # -- request path --------------------------------------------------
+
+    def prefill(self, prompt) -> tuple[dict, wire.RawArrays]:
+        """One prompt pass: returns the page manifest and the filled
+        pages.  O(1) typed ``Overloaded`` past the admission bound; a
+        bad prompt (too long, empty) raises ``ValueError`` — a
+        per-request refusal either way, the replica keeps serving."""
+        with self._gate:
+            if self._inflight >= self.max_pending:
+                self.n_shed += 1
+                monitor.inc("frontdoor/prefill_shed_total")
+                raise Overloaded(
+                    f"prefill admission: {self._inflight} in flight "
+                    f">= max_pending {self.max_pending}")
+            self._inflight += 1
+        try:
+            faults.fire("page_migrate", side="export")
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            t0 = time.perf_counter()
+            with self._lock:
+                seq, logits = self.session.admit(prompt)
+                first = int(np.argmax(logits))
+                k, v = self.session.export_pages(seq)
+                manifest = migrate.page_manifest(
+                    self.session.cfg, prompt, seq.length, first,
+                    version=self.session.version)
+                # pages are exported — this replica is done with the
+                # stream; only the prefix cache may keep them shared
+                self.session.release(seq)
+                self.n_prefills += 1
+            monitor.inc("frontdoor/prefills_total")
+            monitor.observe("frontdoor/prefill_ms",
+                            (time.perf_counter() - t0) * 1000.0)
+            return manifest, wire.RawArrays(k, v)
+        finally:
+            with self._gate:
+                self._inflight -= 1
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._gate:
+            inflight, shed = self._inflight, self.n_shed
+        with self._lock:
+            prefills = self.n_prefills
+            pc = self.session.prefix_cache
+            hits = (None if pc is None
+                    else {"hits": pc.hits, "misses": pc.misses,
+                          "entries": len(pc)})
+        return {
+            "role": "prefill",
+            "version": self.session.version,
+            "prefills": prefills,
+            "inflight": inflight,
+            "max_pending": self.max_pending,
+            "overloaded": shed,
+            "prefix_cache": hits,
+            "compiles": dict(self.session.compiles),
+        }
+
+    # -- wire dispatch -------------------------------------------------
+
+    def rpc_max_workers(self) -> int:
+        # every admissible prefill may block in a handler + slack so
+        # O(1) Overloaded refusals never park behind them
+        return self.max_pending + 8
+
+    def handle(self, op: str, *args):
+        if op == "prefill":
+            (prompt,) = args
+            return self.prefill(prompt)
+        if op == "stats":
+            return self.stats()
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown op {op!r}")
+
+
+def serve(server: PrefillServer, host: str = "0.0.0.0",
+          port: int = DEFAULT_PORT,
+          ready_event: threading.Event | None = None,
+          stop_event: threading.Event | None = None,
+          authkey: bytes | None = None,
+          loop: str | None = None) -> None:
+    """The shared RPC substrate over a :class:`PrefillServer` (same
+    HMAC/wire-v2/typed-err stack as every other plane)."""
+    from theanompi_tpu.parallel.service import _authkey
+
+    if authkey is None:
+        authkey = _authkey(generate=True)
+    rpc.serve(server, host, port, ready_event=ready_event,
+              stop_event=stop_event, authkey=authkey,
+              hooks=rpc.RpcHooks(), loop=loop,
+              max_workers=server.rpc_max_workers())
+
+
+class PrefillClient(ServiceClient):
+    """Wire client for the prefill role: ``prefill`` is pure (the
+    replica keeps no stream state), so at-least-once transport retries
+    are safe; typed ``Overloaded`` re-raises as itself and is never
+    retried by the transport — the ROUTER owns what happens next."""
+
+    def prefill(self, prompt) -> tuple[dict, np.ndarray, np.ndarray]:
+        try:
+            manifest, pages = self.call(
+                "prefill", np.asarray(prompt, np.int32))
+        except ServiceError as e:
+            if Overloaded.__name__ in str(e):
+                raise Overloaded(str(e)) from None
+            raise
+        k, v = pages          # RawArrays decodes to a plain tuple
+        return manifest, k, v
+
+    def stats(self) -> dict:
+        return self.call("stats")
+
+    def ping(self) -> str:
+        return self.call("ping")
+
+    def shutdown(self) -> None:
+        self.call("shutdown")
+
+
+# ---------------------------------------------------------------------------
+# Entry point (frontdoor/fleet.py spawns this module per prefill proc)
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="theanompi-tpu prefill replica (disaggregated "
+                    "serving, docs/SERVING.md 'Disaggregated serving')")
+    ap.add_argument("--export-dir", required=True)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--pages-per-seq", type=int, default=8)
+    ap.add_argument("--max-seqs", type=int, default=8)
+    ap.add_argument("--prefill-buckets", default=None, metavar="N,N,...")
+    ap.add_argument("--max-pending", type=int, default=8)
+    ap.add_argument("--no-prefix-cache", action="store_true")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from theanompi_tpu.utils.helper_funcs import enable_compilation_cache
+
+    enable_compilation_cache()
+    buckets = (tuple(int(b) for b in args.prefill_buckets.split(","))
+               if args.prefill_buckets else None)
+    with monitor.session(stall_after=float("inf"),
+                         name=f"prefill{os.getpid()}"):
+        monitor.progress(phase="frontdoor")
+        server = PrefillServer(
+            args.export_dir, page_size=args.page_size,
+            pages_per_seq=args.pages_per_seq, max_seqs=args.max_seqs,
+            prefill_buckets=buckets, max_pending=args.max_pending,
+            prefix_cache=not args.no_prefix_cache)
+        s = server.session
+        print(f"[frontdoor] PREFILL v{s.version} on "
+              f"{args.host}:{args.port} (window={s.window}, "
+              f"page_size={s.cfg.page_size}, "
+              f"prefill_buckets={s.prefill_buckets}, "
+              f"max_pending={server.max_pending})", flush=True)
+        serve(server, args.host, args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

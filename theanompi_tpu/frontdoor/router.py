@@ -1,0 +1,561 @@
+"""Front-door router — one client stream, two fleets.
+
+The router terminates client ``generate`` streams and splits each one
+across the disaggregated roles (docs/SERVING.md "Disaggregated
+serving"): the prompt phase goes to a PREFILL replica
+(``frontdoor/prefill.py``), the filled KV pages come back as raw
+wire-v2 frames, and the token phase ships pages + manifest to a DECODE
+replica's ``adopt`` op — one RPC per phase, so a stream's decode leg
+has natural per-stream affinity (all its tokens come from the backend
+that adopted its pages).
+
+This is the first consumer built natively on the shared RPC substrate's
+mux transport (ROADMAP item 6): every backend is ONE
+:class:`~theanompi_tpu.parallel.rpc.MuxConnection` (one socket + one
+reader thread) carrying a pool of :class:`ServiceClient` streams, so a
+hundred concurrent streams to a backend cost one fd, not a hundred.
+
+Failure discipline, per leg:
+
+* **Overloaded** (typed, from a backend's admission bound) — try the
+  next live backend of that role ONCE EACH; when every one sheds, the
+  router sheds too, propagating the typed ``Overloaded`` to the client.
+  Load shedding composes; nothing is retried destructively.
+* **Transport loss on the decode leg** (a replica died mid-stream) —
+  FAILOVER: re-prefill from the prompt (the manifest carries it for
+  exactly this) and adopt onto a surviving replica.  The adopt RPC
+  returns the whole stream at once, so no token was delivered before
+  the loss and greedy decode makes the retried stream byte-identical
+  (tests/test_frontdoor.py pins it against the single-role oracle).
+* **IncompatiblePages / ValueError** (typed refusals) — propagate to
+  the client untouched; refusals are answers, not failures.
+
+Backend sets are dynamic (``set_backends`` — the autoscaler's seam):
+a removed backend DRAINS — no new streams route to it, in-flight
+streams finish, and the autoscaler kills the process only once the
+router reports zero streams on it.  Scale events drop nothing.
+
+Trace context rides the existing substrate envelopes: the client's
+span parents the router's dispatch span, whose context every backend
+RPC injects — ``tools/traces.py`` stitches client → router → prefill →
+decode from one collector file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+
+import numpy as np
+
+from theanompi_tpu import monitor
+from theanompi_tpu.analysis.lockgraph import make_lock
+from theanompi_tpu.decode.migrate import IncompatiblePages
+from theanompi_tpu.frontdoor.prefill import PrefillClient
+from theanompi_tpu.parallel import rpc
+from theanompi_tpu.parallel.service import ServiceClient, ServiceError
+from theanompi_tpu.resilience import faults
+from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
+from theanompi_tpu.serving.batcher import Overloaded
+from theanompi_tpu.serving.server import InferenceClient
+
+#: one above the prefill role's 45950
+DEFAULT_PORT = 46000
+
+#: the two downstream roles a router balances over
+ROLES = ("prefill", "decode")
+
+
+def _backend_retry() -> RetryPolicy:
+    """Backend RPCs fail FAST: the router owns recovery (next backend,
+    re-prefill failover), so the transport layer must not sit in a
+    reconnect loop against a replica the autoscaler just killed."""
+    return RetryPolicy(max_attempts=1, name="frontdoor-backend")
+
+
+class _Backend:
+    """One downstream replica: a shared mux transport + client pool.
+
+    Clients serialize their own ``call`` — one concurrent stream needs
+    one client — so the pool hands each stream a private client riding
+    the backend's single multiplexed socket."""
+
+    def __init__(self, role: str, addr: str):
+        self.role = role
+        self.addr = addr
+        self._cls = PrefillClient if role == "prefill" else InferenceClient
+        self._lock = make_lock("frontdoor._Backend._lock")
+        self._mux: rpc.MuxConnection | None = None  # guarded_by: self._lock
+        self._free: list = []      # guarded_by: self._lock
+        self.streams = 0           # guarded_by: self._lock
+        self.draining = False      # guarded_by: self._lock
+        self.errors = 0            # guarded_by: self._lock
+
+    def _transport(self) -> rpc.MuxConnection:
+        with self._lock:
+            mux = self._mux
+        if mux is not None:
+            return mux
+        mux = rpc.MuxConnection(self.addr)      # network IO: no lock
+        with self._lock:
+            if self._mux is None:
+                self._mux = mux
+                return mux
+            extra = mux
+        extra.close()
+        return self._transport()
+
+    def acquire(self):
+        """A client for one stream (pooled), counting the stream in."""
+        with self._lock:
+            self.streams += 1
+            if self._free:
+                return self._free.pop()
+        try:
+            return self._cls(self.addr, transport=self._transport(),
+                             retry=_backend_retry())
+        except BaseException:
+            with self._lock:
+                self.streams -= 1
+            raise
+
+    def release(self, client, ok: bool) -> bool:
+        """Return a stream's client; a transport-broken one is closed
+        instead of pooled.  Returns True when this was a draining
+        backend's LAST stream — the caller closes the backend."""
+        with self._lock:
+            self.streams -= 1
+            if not ok:
+                self.errors += 1
+            if ok and not self.draining:
+                self._free.append(client)
+                return False
+            draining = self.draining
+            last = draining and self.streams == 0
+        if not ok or draining:
+            try:
+                client.close()
+            except Exception:
+                pass
+        return last
+
+    def close(self) -> None:
+        with self._lock:
+            free, self._free = self._free, []
+            mux, self._mux = self._mux, None
+        for c in free:
+            try:
+                c.close()
+            except Exception:
+                pass
+        if mux is not None:
+            mux.close()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"addr": self.addr, "role": self.role,
+                    "streams": self.streams, "draining": self.draining,
+                    "errors": self.errors}
+
+
+class Router:
+    """Stream terminator + role balancer (module docstring)."""
+
+    def __init__(self, prefill: list[str] | None = None,
+                 decode: list[str] | None = None,
+                 max_streams: int = 64, failover_attempts: int = 2):
+        self.max_streams = int(max_streams)
+        self.failover_attempts = int(failover_attempts)
+        self._lock = make_lock("frontdoor.Router._lock")
+        self._backends: dict[str, list[_Backend]] = {
+            r: [] for r in ROLES}                 # guarded_by: self._lock
+        self._rr = {r: 0 for r in ROLES}          # guarded_by: self._lock
+        self._active = 0                          # guarded_by: self._lock
+        self.n_streams = 0                        # guarded_by: self._lock
+        self.n_shed = 0                           # guarded_by: self._lock
+        self.n_failovers = 0                      # guarded_by: self._lock
+        for addr in prefill or []:
+            self.add_backend("prefill", addr)
+        for addr in decode or []:
+            self.add_backend("decode", addr)
+
+    # -- backend set (the autoscaler's seam) ---------------------------
+
+    def add_backend(self, role: str, addr: str) -> None:
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r} (want {ROLES})")
+        addr = str(addr)
+        with self._lock:
+            for b in self._backends[role]:
+                if b.addr == addr:
+                    # re-adding a draining backend revives it — the
+                    # autoscaler flip-flopped inside one drain window
+                    with b._lock:  # lint: ok TM101
+                        b.draining = False
+                    return
+            self._backends[role].append(_Backend(role, addr))
+        monitor.set_gauge("frontdoor/backends", self._role_size(role),
+                          role=role)
+
+    def remove_backend(self, role: str, addr: str) -> None:
+        """Start DRAINING one backend: no new streams route to it;
+        in-flight streams finish and the last one out closes it.  The
+        autoscaler kills the process only at ``streams == 0``
+        (``backend_streams``) — scale-down drops nothing."""
+        drained = None
+        with self._lock:
+            for b in self._backends[role]:
+                if b.addr == str(addr):
+                    with b._lock:  # lint: ok TM101
+                        b.draining = True
+                        if b.streams == 0:
+                            drained = b
+                    break
+            if drained is not None:
+                self._backends[role].remove(drained)
+        if drained is not None:
+            drained.close()
+        monitor.set_gauge("frontdoor/backends", self._role_size(role),
+                          role=role)
+
+    def set_backends(self, role: str, addrs: list[str]) -> None:
+        """Reconcile one role's backend set (adds + drains)."""
+        want = [str(a) for a in addrs]
+        with self._lock:
+            have = [b.addr for b in self._backends[role]]
+        for a in want:
+            if a not in have:
+                self.add_backend(role, a)
+        for a in have:
+            if a not in want:
+                self.remove_backend(role, a)
+
+    def backend_streams(self, role: str, addr: str) -> int:
+        """In-flight streams on one backend (0 also when the backend
+        is already gone) — the autoscaler's drain barrier."""
+        with self._lock:
+            for b in self._backends[role]:
+                if b.addr == str(addr):
+                    with b._lock:  # lint: ok TM101
+                        return b.streams
+        return 0
+
+    def _role_size(self, role: str) -> int:
+        with self._lock:
+            return sum(1 for b in self._backends[role]
+                       if not b.draining)
+
+    def _candidates(self, role: str) -> list[_Backend]:
+        """Live (non-draining) backends in round-robin order, rotated
+        one step per call — each stream starts on the next backend and
+        fails over through the rest."""
+        with self._lock:
+            live = [b for b in self._backends[role] if not b.draining]
+            if not live:
+                return []
+            start = self._rr[role] % len(live)
+            self._rr[role] += 1
+            return live[start:] + live[:start]
+
+    def _drop_if_drained(self, b: _Backend) -> None:
+        with self._lock:
+            try:
+                self._backends[b.role].remove(b)
+            except ValueError:
+                return  # a concurrent releaser already dropped it
+        b.close()
+
+    # -- request path --------------------------------------------------
+
+    def _prefill_leg(self, prompt: np.ndarray):
+        """Prompt phase: first willing prefill replica wins.  Typed
+        ``Overloaded`` tries the next; transport loss tries the next;
+        any other typed error (bad prompt) propagates — it would fail
+        identically everywhere."""
+        backends = self._candidates("prefill")
+        if not backends:
+            with self._lock:
+                self.n_shed += 1
+            monitor.inc("frontdoor/shed_total", role="prefill")
+            raise Overloaded("no live prefill backends (the fleet is "
+                             "scaled to zero or still coming up)")
+        t0 = time.perf_counter()
+        last: BaseException | None = None
+        for b in backends:
+            client = b.acquire()
+            ok = True
+            try:
+                manifest, k, v = client.prefill(prompt)
+            except Overloaded as e:
+                last = e
+                continue
+            except ServiceError:
+                raise
+            except CONNECTION_ERRORS as e:
+                ok = False
+                last = e
+                continue
+            finally:
+                if b.release(client, ok):
+                    self._drop_if_drained(b)
+            monitor.inc("frontdoor/routed_total", role="prefill")
+            monitor.observe("frontdoor/migrate_ms",
+                            (time.perf_counter() - t0) * 1000.0)
+            return manifest, k, v
+        if isinstance(last, Overloaded):
+            with self._lock:
+                self.n_shed += 1
+            monitor.inc("frontdoor/shed_total", role="prefill")
+            raise Overloaded(f"every prefill backend shed: {last}")
+        raise ConnectionError(
+            f"every prefill backend unreachable: {last}") from last
+
+    def _decode_leg(self, manifest: dict, k, v, max_new):
+        """Token phase: adopt the pages on one decode replica and run
+        the stream there (per-stream affinity = one RPC, one backend).
+        Transport loss raises for :meth:`generate`'s failover loop."""
+        backends = self._candidates("decode")
+        if not backends:
+            with self._lock:
+                self.n_shed += 1
+            monitor.inc("frontdoor/shed_total", role="decode")
+            raise Overloaded("no live decode backends (the fleet is "
+                             "scaled to zero or still coming up)")
+        last: Overloaded | None = None
+        for b in backends:
+            client = b.acquire()
+            ok = True
+            try:
+                out = client.adopt(manifest, k, v, max_new)
+            except Overloaded as e:
+                last = e
+                continue
+            except CONNECTION_ERRORS as e:
+                ok = False
+                raise ConnectionError(
+                    f"decode backend {b.addr} lost mid-stream: {e}"
+                ) from e
+            finally:
+                if b.release(client, ok):
+                    self._drop_if_drained(b)
+            monitor.inc("frontdoor/routed_total", role="decode")
+            return out
+        with self._lock:
+            self.n_shed += 1
+        monitor.inc("frontdoor/shed_total", role="decode")
+        raise Overloaded(f"every decode backend shed: {last}")
+
+    def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
+        """One full client stream across the two fleets; returns the
+        generated token ids (first token included), byte-identical to
+        a single-role decode server's ``generate`` of the same prompt."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            if self._active >= self.max_streams:
+                self.n_shed += 1
+                monitor.inc("frontdoor/shed_total", role="router")
+                raise Overloaded(
+                    f"router admission: {self._active} streams in "
+                    f"flight >= max_streams {self.max_streams}")
+            self._active += 1
+            self.n_streams += 1
+        monitor.add_gauge("frontdoor/streams_active", 1.0)
+        try:
+            with monitor.span("page_migrate", phase="prefill"):
+                manifest, k, v = self._prefill_leg(prompt)
+            for attempt in range(self.failover_attempts + 1):
+                try:
+                    out = self._decode_leg(manifest, k, v, max_new)
+                    return np.asarray(out, np.int32)
+                except ConnectionError as e:
+                    if attempt >= self.failover_attempts:
+                        raise
+                    # the decode replica died mid-stream; no token of
+                    # this stream was delivered (the adopt RPC returns
+                    # whole streams), so re-prefilling the prompt and
+                    # adopting onto a survivor reproduces the greedy
+                    # stream byte-for-byte
+                    with self._lock:
+                        self.n_failovers += 1
+                    monitor.inc("frontdoor/failovers_total")
+                    print(f"[frontdoor] decode leg failover "
+                          f"({attempt + 1}/{self.failover_attempts}): "
+                          f"{e}", flush=True)
+                    with monitor.span("page_migrate", phase="failover"):
+                        manifest, k, v = self._prefill_leg(prompt)
+            raise AssertionError("unreachable")  # loop returns or raises
+        finally:
+            with self._lock:
+                self._active -= 1
+            monitor.add_gauge("frontdoor/streams_active", -1.0)
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            backends = {r: [b.snapshot() for b in self._backends[r]]
+                        for r in ROLES}
+            out = {
+                "role": "router",
+                "active_streams": self._active,
+                "max_streams": self.max_streams,
+                "streams": self.n_streams,
+                "shed": self.n_shed,
+                "failovers": self.n_failovers,
+            }
+        out["backends"] = backends
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            backends = [b for r in ROLES for b in self._backends[r]]
+            self._backends = {r: [] for r in ROLES}
+        for b in backends:
+            b.close()
+
+    # -- wire dispatch -------------------------------------------------
+
+    def rpc_max_workers(self) -> int:
+        # every admissible stream may park in a handler for its whole
+        # decode leg + slack for O(1) sheds and control ops
+        return self.max_streams + 8
+
+    def handle(self, op: str, *args):
+        if op == "generate":
+            prompt, max_new = args
+            return self.generate(prompt,
+                                 None if max_new is None else int(max_new))
+        if op == "stats":
+            return self.stats()
+        if op == "set_backends":
+            role, addrs = args
+            self.set_backends(str(role), list(addrs))
+            return "ok"
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown op {op!r}")
+
+
+class _FrontdoorRpcHooks(rpc.RpcHooks):
+    """The frontdoor plane's seams into the shared RPC substrate:
+    literal ``frontdoor/*`` series names (the TM403/404 docs-coverage
+    contract) and the ``router_route`` fault site."""
+
+    plane = "frontdoor"
+
+    def on_connect(self) -> None:
+        monitor.add_gauge("frontdoor/clients", 1.0)
+
+    def on_disconnect(self) -> None:
+        monitor.add_gauge("frontdoor/clients", -1.0)
+
+    def on_request(self, op: str, ms: float) -> None:
+        monitor.inc("frontdoor/requests_total", op=op)
+        monitor.observe("frontdoor/rpc_ms", ms, op=op)
+        monitor.progress(phase="frontdoor")
+
+    def on_error(self, op: str) -> None:
+        monitor.inc("frontdoor/errors_total", op=op)
+
+    def on_negotiate(self, opts) -> None:
+        monitor.inc("frontdoor/wire_negotiations_total",
+                    compression=opts.compression, dtype=opts.dtype)
+
+    def fire(self, op: str) -> None:
+        # fault plane: 'raise' rejects this routed request (the client
+        # sees the typed err), 'delay' adds router latency — with the
+        # fleets live, which is the point
+        faults.fire("router_route", op=op)
+
+
+def serve(router: Router, host: str = "0.0.0.0",
+          port: int = DEFAULT_PORT,
+          ready_event: threading.Event | None = None,
+          stop_event: threading.Event | None = None,
+          authkey: bytes | None = None,
+          loop: str | None = None) -> None:
+    """The shared RPC substrate over a :class:`Router`."""
+    from theanompi_tpu.parallel.service import _authkey
+
+    if authkey is None:
+        authkey = _authkey(generate=True)
+    rpc.serve(router, host, port, ready_event=ready_event,
+              stop_event=stop_event, authkey=authkey,
+              hooks=_FrontdoorRpcHooks(), loop=loop,
+              max_workers=router.rpc_max_workers())
+
+
+class RouterClient(ServiceClient):
+    """Wire client for the front door: what a serving client points at
+    when the fleet is disaggregated.  Same surface as
+    :class:`~theanompi_tpu.serving.server.InferenceClient.generate`,
+    same typed re-raises — callers cannot tell the topologies apart."""
+
+    def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
+        try:
+            return np.asarray(
+                self.call("generate", np.asarray(prompt, np.int32),
+                          None if max_new is None else int(max_new)),
+                np.int32)
+        except ServiceError as e:
+            if Overloaded.__name__ in str(e):
+                raise Overloaded(str(e)) from None
+            if IncompatiblePages.__name__ in str(e):
+                raise IncompatiblePages(str(e)) from None
+            raise
+
+    def stats(self) -> dict:
+        return self.call("stats")
+
+    def set_backends(self, role: str, addrs: list[str]) -> None:
+        self.call("set_backends", str(role), [str(a) for a in addrs])
+
+    def ping(self) -> str:
+        return self.call("ping")
+
+    def shutdown(self) -> None:
+        self.call("shutdown")
+
+
+# ---------------------------------------------------------------------------
+# Entry point (a bare router over existing fleets; frontdoor/fleet.py
+# spawns whole fleets and runs the router in-process)
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="theanompi-tpu front-door router (disaggregated "
+                    "serving, docs/SERVING.md)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=DEFAULT_PORT)
+    ap.add_argument("--prefill", default="", metavar="HOST:PORT,...",
+                    help="comma-separated prefill backends")
+    ap.add_argument("--decode", default="", metavar="HOST:PORT,...",
+                    help="comma-separated decode backends "
+                         "(decode-mode tmserver instances)")
+    ap.add_argument("--max-streams", type=int, default=64)
+    ap.add_argument("--failover-attempts", type=int, default=2)
+    args = ap.parse_args(argv)
+    prefill = [a for a in args.prefill.split(",") if a]
+    decode = [a for a in args.decode.split(",") if a]
+    with monitor.session(stall_after=float("inf"),
+                         name=f"router{os.getpid()}"):
+        monitor.progress(phase="frontdoor")
+        router = Router(prefill=prefill, decode=decode,
+                        max_streams=args.max_streams,
+                        failover_attempts=args.failover_attempts)
+        print(f"[frontdoor] ROUTER on {args.host}:{args.port} "
+              f"({len(prefill)} prefill / {len(decode)} decode "
+              f"backends, max_streams={args.max_streams})", flush=True)
+        try:
+            serve(router, args.host, args.port)
+        finally:
+            router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

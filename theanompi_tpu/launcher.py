@@ -253,6 +253,24 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                         "prefix cache (copy-on-write KV page sharing "
                         "is on by default — docs/SERVING.md 'Prefix "
                         "cache')")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="SERVE --decode: split the deployment into a "
+                        "prefill fleet + decode fleet behind the "
+                        "front-door router (theanompi_tpu/frontdoor, "
+                        "docs/SERVING.md 'Disaggregated serving'); "
+                        "--serve-replicas sizes the decode fleet")
+    p.add_argument("--prefill-replicas", type=int, default=1,
+                   help="SERVE --disaggregate: initial prefill "
+                        "replica count")
+    p.add_argument("--autoscale", action="store_true",
+                   help="SERVE --disaggregate: grow/shrink both roles "
+                        "from load signals (frontdoor/autoscale.py)")
+    p.add_argument("--scale-max", type=int, default=4,
+                   help="SERVE --disaggregate --autoscale: max "
+                        "replicas per role (the fleet budget)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="SERVE --disaggregate --autoscale: intertoken "
+                        "p99 target feeding the decode scale signal")
     p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
                    help="persistent XLA compilation cache "
                         "(utils/helper_funcs.enable_compilation_cache): "
@@ -470,6 +488,40 @@ def _run_session(args, multihost: bool) -> int:
 
         buckets = (tuple(int(b) for b in args.serve_buckets.split(","))
                    if args.serve_buckets else None)
+        if args.disaggregate:
+            if not args.decode:
+                # prefill/decode disaggregation only exists on the
+                # decode plane — the eval server has no KV pages
+                raise SystemExit("--disaggregate requires --decode "
+                                 "(tmlocal SERVE --decode "
+                                 "--disaggregate ...)")
+            from theanompi_tpu.frontdoor import fleet as frontdoor_fleet
+            from theanompi_tpu.frontdoor.router import (
+                DEFAULT_PORT as ROUTER_PORT,
+            )
+
+            pb = (tuple(int(b)
+                        for b in args.decode_prefill_buckets.split(","))
+                  if args.decode_prefill_buckets else None)
+            return frontdoor_fleet.run_foreground(
+                export_dir=args.export_dir,
+                prefill=args.prefill_replicas,
+                decode=args.serve_replicas,
+                router_host=args.serve_host,
+                router_port=(args.port if args.port is not None
+                             else ROUTER_PORT),
+                page_size=args.decode_page_size,
+                pages_per_seq=args.decode_pages_per_seq,
+                max_seqs=args.decode_max_seqs,
+                prefill_buckets=pb,
+                decode_max_pending=args.decode_max_pending,
+                prefix_cache=not args.decode_no_prefix_cache,
+                draft_export_dir=args.decode_draft_export_dir,
+                speculate_k=args.decode_speculate_k,
+                autoscale=args.autoscale, scale_max=args.scale_max,
+                slo_p99_ms=args.slo_p99_ms,
+                max_restarts=(1 if args.max_restarts is None
+                              else args.max_restarts))
         decode_opts = decode_opts_from_args(args)
         return serve_main(
             args.export_dir, host=args.serve_host,

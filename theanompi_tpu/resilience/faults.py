@@ -35,7 +35,14 @@ Spec fields:
     real kill, e.g. ``IngestProcessGroup.kill_reader`` or the bench
     ``--smoke`` leg) and ``ingest_pull`` (trainer-side fetch; coords
     ``index``, ``rank`` — ``raise`` injects a trainer-side stream
-    failure).
+    failure).  Disaggregated serving (docs/SERVING.md "Disaggregated
+    serving") adds ``router_route`` (the front-door router's
+    per-request handler; coord ``op`` — ``raise`` fails a client
+    stream at the router before any backend is touched) and
+    ``page_migrate`` (the KV-page migration legs; coords ``side`` =
+    ``export``/``adopt`` and, on the adopt side, ``replica`` —
+    ``raise`` on ``export`` sheds the prefill, on ``adopt`` it fails
+    the decode leg and exercises router failover).
 ``action``
     ``raise`` (default) raises :class:`FaultInjected` at the site;
     ``delay`` sleeps ``delay_s`` seconds (default 0.1) then lets the

@@ -44,7 +44,8 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_lock
-from theanompi_tpu.parallel import rpc
+from theanompi_tpu.decode.migrate import IncompatiblePages
+from theanompi_tpu.parallel import rpc, wire
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.serving.batcher import (
     BatchPolicy,
@@ -311,6 +312,21 @@ class InferenceServer:
         out = self._route("generate", prompt, max_new)
         return np.asarray(out, np.int32)
 
+    def generate_adopted(self, manifest: dict, k, v,
+                         max_new: int | None = None) -> np.ndarray:
+        """Route one MIGRATED stream (decode/migrate.py: a prefill
+        replica's pages + manifest) to a live decode replica, which
+        adopts the pages and decodes from there.  A geometry mismatch
+        raises the typed :class:`IncompatiblePages` straight through
+        ``_route`` — a per-stream refusal, never a replica failure."""
+        if not self.decode:
+            raise ValueError("this server runs eval mode; start it "
+                             "with decode=True (tmlocal SERVE "
+                             "--decode) for the adopt op")
+        out = self._route("generate_adopted", manifest,
+                          np.asarray(k), np.asarray(v), max_new)
+        return np.asarray(out, np.int32)
+
     # -- hot reload ----------------------------------------------------
 
     def check_reload(self) -> int:
@@ -536,6 +552,14 @@ class InferenceServer:
             return self.generate(np.asarray(prompt, np.int32),
                                  None if max_new is None
                                  else int(max_new))
+        if op == "adopt":
+            # pages arrive as one RawArrays frame pair (decoded to a
+            # plain (k, v) tuple by the wire) + the page manifest
+            manifest, pages, max_new = args
+            k, v = pages
+            return self.generate_adopted(manifest, k, v,
+                                         None if max_new is None
+                                         else int(max_new))
         if op == "stats":
             return self.stats()
         if op == "reload":
@@ -649,6 +673,30 @@ class InferenceClient(ServiceClient):
         except ServiceError as e:
             if Overloaded.__name__ in str(e):
                 raise Overloaded(str(e)) from None
+            raise
+
+    def adopt(self, manifest: dict, k, v,
+              max_new: int | None = None) -> np.ndarray:
+        """Ship one migrated stream (page manifest + KV pages) to a
+        decode-mode server; returns its generated token ids, first
+        token included.  The pages travel as one ``RawArrays`` frame
+        pair — the raw uint8 path, no compression and no wire-dtype
+        re-encode, because KV bytes must arrive EXACTLY as prefilled
+        (byte-identity is pinned at the bench level).  Geometry
+        mismatches re-raise the server's typed
+        :class:`~theanompi_tpu.decode.migrate.IncompatiblePages`;
+        admission rejections re-raise :class:`Overloaded` — the
+        connection survives both."""
+        try:
+            return np.asarray(
+                self.call("adopt", manifest, wire.RawArrays(k, v),
+                          None if max_new is None else int(max_new)),
+                np.int32)
+        except ServiceError as e:
+            if Overloaded.__name__ in str(e):
+                raise Overloaded(str(e)) from None
+            if IncompatiblePages.__name__ in str(e):
+                raise IncompatiblePages(str(e)) from None
             raise
 
     def stats(self) -> dict:

@@ -37,6 +37,25 @@ re-exported at bf16 (self-speculation: same argmax almost always, so
 it measures the accept machinery honestly; a real deployment exports
 a separately trained smaller draft).
 
+Mixed trace (``--decode --mode mixed-trace``): the disaggregation
+workload — open-loop SHORT chat streams (Poisson at ``--rate``) with
+periodic LONG-prompt arrivals (``--long-every-s``) whose prefill is
+compute-bound.  Four legs on fresh in-process servers with IDENTICAL
+decode capacity: single-role short-only (its baseline), single-role
+mixed (the long prefills run between decode steps of the one shared
+loop and stall every live stream), disaggregated short-only and
+disaggregated mixed (prefill fleet + router + decode fleet — the
+decode replica only ever executes cheap adopt scatters).  Headline:
+short-stream **inter-token p99** per leg, from the decode server's
+own histogram (reset after the warm pass), plus the two ratios the
+acceptance pins — single-role mixed blows its baseline up, the
+disaggregated fleet holds ~1x.  ``--scale-drill`` appends a REAL
+``DisaggregatedFleet`` (subprocess roles, autoscaler on) driven past
+the prefill admission bound until scale-up fires, and records the
+executed scale events + zero dropped streams; the run's monitor JSONL
+lands in ``--monitor-dir``.  The smoke artifact lives at
+``artifacts/BENCH_disagg_smoke.json``.
+
 Emits one ``BENCH_serving`` JSON (throughput, latency p50/p95/p99,
 batch occupancy / decode sharing from the server's own stats, overload
 counts) to ``--out`` and prints it — same artifact discipline as the
@@ -59,6 +78,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import socket
 import sys
 import threading
@@ -488,6 +508,497 @@ def trace_main(args, tmp_dir: str) -> dict:
     return out
 
 
+def make_mixed_workload(vocab: int, n_short: int, short_tokens: int,
+                        long_tokens: int, rate: float,
+                        long_every_s: float, seed: int = 0):
+    """Deterministic open-loop schedule: ``n_short`` short-chat
+    arrivals on a pre-drawn Poisson clock at ``rate`` req/s, plus one
+    long-prompt arrival every ``long_every_s`` inside that horizon.
+    Every prompt is DISTINCT random tokens (no page-aligned shared
+    prefixes → no prefix-cache hits), so the same schedule replays
+    byte-comparable prompts across all legs."""
+    rng = np.random.default_rng(seed)
+    top = max(2, vocab - 1)
+    t_short = np.cumsum(rng.exponential(1.0 / rate, n_short))
+    shorts = [(float(t_short[i]),
+               rng.integers(0, top, short_tokens).astype(np.int32) + 1)
+              for i in range(n_short)]
+    longs = []
+    t = long_every_s
+    while t < float(t_short[-1]):
+        longs.append((float(t),
+                      rng.integers(0, top,
+                                   long_tokens).astype(np.int32) + 1))
+        t += long_every_s
+    return shorts, longs
+
+
+def run_mixed(make_client, shorts, longs, gen_short: int,
+              gen_long: int) -> dict:
+    """Replay one mixed schedule open-loop: each arrival gets its own
+    thread + connection (streams hold their connection, so the server's
+    admission bound — not a client pool — is what saturates).  Returns
+    per-class counts and per-arrival outputs (index-aligned with the
+    schedule, so legs compare byte-for-byte)."""
+    from theanompi_tpu.serving import Overloaded
+
+    lock = threading.Lock()
+    out_short: list[dict | None] = [None] * len(shorts)
+    out_long: list[dict | None] = [None] * len(longs)
+    counts = {"short": {"ok": 0, "overloaded": 0, "errors": 0},
+              "long": {"ok": 0, "overloaded": 0, "errors": 0}}
+
+    def one(cls, idx, prompt, gen, sink):
+        t0 = time.monotonic()
+        client = None
+        try:
+            client = make_client()
+            out = client.generate(prompt, gen)
+        except Overloaded:
+            with lock:
+                counts[cls]["overloaded"] += 1
+            return
+        except Exception:
+            with lock:
+                counts[cls]["errors"] += 1
+            return
+        finally:
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:
+                    pass
+        with lock:
+            counts[cls]["ok"] += 1
+            sink[idx] = {"wall_s": time.monotonic() - t0,
+                         "out": [int(t) for t in out]}
+
+    arrivals = ([("short", i, at, p, gen_short, out_short)
+                 for i, (at, p) in enumerate(shorts)]
+                + [("long", i, at, p, gen_long, out_long)
+                   for i, (at, p) in enumerate(longs)])
+    arrivals.sort(key=lambda a: a[2])
+    t_start = time.monotonic()
+    threads = []
+    for cls, idx, at, prompt, gen, sink in arrivals:
+        delay = at - (time.monotonic() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=one,
+                              args=(cls, idx, prompt, gen, sink))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    return {
+        "wall_s": time.monotonic() - t_start,
+        "counts": counts,
+        "short_outputs": [s["out"] if s else None for s in out_short],
+        "long_outputs": [s["out"] if s else None for s in out_long],
+    }
+
+
+def _measure_mixed_leg(make_client, server, warm_long, warm_shorts,
+                       shorts, longs, args) -> dict:
+    """Warm pass → drop the decode server's inter-token ring →
+    measured replay.  The warm pass must compile every program the
+    measured pass can touch: both prompt buckets, AND the decode
+    BATCH buckets — those only compile at the concurrency that
+    reaches them, so the short warms run ``max_seqs`` wide with
+    decaying generation lengths (the active set drains 8→4→2→1
+    through every power-of-two bucket)."""
+    c = make_client()
+    try:
+        c.generate(warm_long, args.long_gen_tokens)
+    finally:
+        c.close()
+
+    def one(prompt, gen):
+        cc = make_client()
+        try:
+            cc.generate(prompt, gen)
+        finally:
+            cc.close()
+
+    threads = [threading.Thread(target=one, args=(p, g))
+               for p, g in warm_shorts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    warm_compiles = [dict(r.batcher.stats()["compiles"])
+                     for r in server.replicas]
+    for r in server.replicas:
+        r.batcher.reset_intertoken()
+    res = run_mixed(make_client, shorts, longs, args.gen_tokens,
+                    args.long_gen_tokens)
+    measured_compiles = [dict(r.batcher.stats()["compiles"])
+                        for r in server.replicas]
+    # steady-state pin (same contract as --mode trace): a compile gap
+    # in the measured pass would sit in the p99 and lie about physics
+    res["zero_steady_state_recompiles"] = (warm_compiles
+                                           == measured_compiles)
+    return res
+
+
+def _mixed_leg_summary(res: dict, st: dict) -> dict:
+    rep = (st.get("replicas") or [{}])[0] or {}
+    return {
+        "wall_s": res["wall_s"],
+        "counts": res["counts"],
+        "zero_steady_state_recompiles":
+            res.get("zero_steady_state_recompiles"),
+        "intertoken_ms": rep.get("intertoken_ms"),
+        "server": {"tokens": st.get("tokens"), "steps": st.get("steps"),
+                   "adopted": rep.get("adopted"),
+                   "adopt_refused": rep.get("adopt_refused")},
+    }
+
+
+def _outputs_identical(a: list, b: list) -> dict:
+    """Index-aligned byte-identity over arrivals that completed in
+    BOTH legs (an Overloaded shed in one leg just shrinks the set)."""
+    both = [(x, y) for x, y in zip(a, b)
+            if x is not None and y is not None]
+    return {"identical": bool(both) and all(x == y for x, y in both),
+            "compared": len(both)}
+
+
+def _scale_drill(export_dir: str, args, monitor_dir: str) -> dict:
+    """The autoscaler leg, on a REAL subprocess fleet: a tiny prefill
+    admission bound (max_pending=2) gets hammered with concurrent
+    long-prompt streams until the overload signal trips the
+    hysteresis controller and a scale-up executes; then a fresh wave
+    must land entirely on the grown fleet — zero errors, zero sheds.
+    The whole drill runs under a monitor session rooted at
+    ``monitor_dir`` with ``$THEANOMPI_TPU_MONITOR`` exported, so every
+    role process ships its metrics JSONL there — the committed
+    evidence."""
+    from theanompi_tpu import monitor
+    from theanompi_tpu.frontdoor.fleet import DisaggregatedFleet
+    from theanompi_tpu.frontdoor.router import RouterClient
+    from theanompi_tpu.serving import Overloaded
+
+    monitor_dir = os.path.abspath(monitor_dir)
+    os.makedirs(monitor_dir, exist_ok=True)
+    rng = np.random.default_rng(7)
+    top = 63
+    long_prompt = lambda: (rng.integers(0, top,
+                           args.long_prompt_tokens).astype(np.int32) + 1)
+    short_prompt = lambda: (rng.integers(0, top,
+                            args.prompt_tokens).astype(np.int32) + 1)
+    buckets = (tuple(int(b) for b in
+                     args.decode_prefill_buckets.split(","))
+               if args.decode_prefill_buckets else None)
+    prev_env = os.environ.get(monitor.ENV_VAR)
+    os.environ[monitor.ENV_VAR] = monitor_dir  # fan out to children
+    try:
+        with monitor.session(run_dir=monitor_dir,
+                             stall_after=float("inf"),
+                             name="bench_frontdoor"):
+            monitor.progress(phase="frontdoor")
+            with DisaggregatedFleet(
+                    export_dir, prefill=1, decode=1,
+                    router_host="127.0.0.1",
+                    page_size=args.decode_page_size,
+                    pages_per_seq=args.decode_pages_per_seq,
+                    max_seqs=args.decode_max_seqs,
+                    prefill_buckets=buckets,
+                    prefill_max_pending=2,
+                    decode_max_pending=args.decode_max_pending,
+                    autoscale=True, scale_max=2,
+                    scale_poll_s=0.5) as fleet:
+                addr = fleet.router_addr
+                lock = threading.Lock()
+                hammer = {"ok": 0, "overloaded": 0, "errors": 0}
+                stop = threading.Event()
+
+                def drive():
+                    while not stop.is_set():
+                        c = None
+                        try:
+                            c = RouterClient(addr)
+                            c.generate(long_prompt(),
+                                       args.long_gen_tokens)
+                            with lock:
+                                hammer["ok"] += 1
+                        except Overloaded:
+                            with lock:
+                                hammer["overloaded"] += 1
+                        except Exception:
+                            with lock:
+                                hammer["errors"] += 1
+                        finally:
+                            if c is not None:
+                                try:
+                                    c.close()
+                                except Exception:
+                                    pass
+
+                drivers = [threading.Thread(target=drive)
+                           for _ in range(6)]
+                for d in drivers:
+                    d.start()
+                # wait for the executed scale-up (grow() blocks the
+                # autoscaler tick until the new replica answers, so
+                # this also covers the replica's JAX warmup)
+                deadline = time.monotonic() + 240
+                while time.monotonic() < deadline:
+                    if fleet.autoscaler.events:
+                        break
+                    time.sleep(0.25)
+                stop.set()
+                for d in drivers:
+                    d.join()
+                events = list(fleet.autoscaler.events)
+                # new traffic onto the grown fleet: nothing may drop
+                post = {"ok": 0, "overloaded": 0, "errors": 0}
+
+                def wave():
+                    c = None
+                    try:
+                        c = RouterClient(addr)
+                        c.generate(long_prompt(), args.long_gen_tokens)
+                        c.generate(short_prompt(), args.gen_tokens)
+                        with lock:
+                            post["ok"] += 1
+                    except Overloaded:
+                        with lock:
+                            post["overloaded"] += 1
+                    except Exception:
+                        with lock:
+                            post["errors"] += 1
+                    finally:
+                        if c is not None:
+                            try:
+                                c.close()
+                            except Exception:
+                                pass
+
+                waves = [threading.Thread(target=wave)
+                         for _ in range(4)]
+                for w in waves:
+                    w.start()
+                for w in waves:
+                    w.join()
+                router_stats = RouterClient(addr).stats()
+    finally:
+        if prev_env is None:
+            os.environ.pop(monitor.ENV_VAR, None)
+        else:
+            os.environ[monitor.ENV_VAR] = prev_env
+    return {
+        "monitor_dir": monitor_dir,
+        "monitor_files": sorted(os.listdir(monitor_dir)),
+        "scale_events": [{"role": r, "direction": d, "addr": a}
+                         for r, d, a in events],
+        "hammer": hammer,
+        "post_scale_wave": post,
+        "router": {k: router_stats.get(k)
+                   for k in ("streams", "shed", "failovers")},
+        "acceptance": {
+            "scale_up_executed": any(d == "up" for _, d, _ in events),
+            "zero_dropped_streams": (hammer["errors"] == 0
+                                     and post["errors"] == 0),
+            "post_scale_wave_fully_admitted": (
+                post["ok"] == 4 and post["overloaded"] == 0),
+        },
+    }
+
+
+def mixed_main(args, tmp_dir: str) -> dict:
+    """The disaggregation workload (module docstring): four legs with
+    identical decode capacity, short-stream inter-token p99 headline,
+    byte-identity across topologies, optional autoscale drill."""
+    from theanompi_tpu.frontdoor import router as router_mod
+    from theanompi_tpu.frontdoor.autoscale import RoleGroup
+    from theanompi_tpu.frontdoor.router import Router, RouterClient
+    from theanompi_tpu.serving import InferenceClient, load_export
+
+    export_dir = args.export_dir
+    if export_dir is None:
+        if not args.demo:
+            raise SystemExit(
+                "--mode mixed-trace needs --export-dir or --demo (it "
+                "starts its own servers and fleets)")
+        export_dir = _demo_export(
+            tmp_dir, decode=True, d_model=args.demo_d_model,
+            n_layers=args.demo_layers, n_heads=args.demo_heads,
+            vocab=args.demo_vocab, seq_len=args.demo_seq_len)
+    export_dir = os.path.abspath(export_dir)
+    meta = load_export(export_dir).meta
+    vocab = int((meta.get("net") or {}).get("vocab", 64))
+    shorts, longs = make_mixed_workload(
+        vocab, args.short_streams, args.prompt_tokens,
+        args.long_prompt_tokens, args.rate, args.long_every_s)
+    wrng = np.random.default_rng(1234)
+    top = max(2, vocab - 1)
+    warm_long = (wrng.integers(0, top, args.long_prompt_tokens)
+                 .astype(np.int32) + 1)
+    warm_shorts = [
+        (wrng.integers(0, top, args.prompt_tokens)
+         .astype(np.int32) + 1, max(2, 2 * (i + 1)))
+        for i in range(args.decode_max_seqs)]
+
+    legs: dict[str, dict] = {}
+    outputs: dict[str, dict] = {}
+
+    # -- single-role pair: one decode server does both phases ----------
+    for name, leg_longs in (("single_short", []),
+                            ("single_mixed", longs)):
+        print(f"[mixed-trace] leg {name} ...", flush=True)
+        server, sthread, addr = _start_decode_server(
+            export_dir, args, None, prefix_cache=True)
+        try:
+            res = _measure_mixed_leg(
+                lambda: InferenceClient(addr), server, warm_long,
+                warm_shorts, shorts, leg_longs, args)
+            probe = InferenceClient(addr)
+            st = probe.stats()
+            probe.shutdown()
+            probe.close()
+        finally:
+            server.stop()
+            sthread.join(timeout=10)
+        legs[name] = _mixed_leg_summary(res, st)
+        outputs[name] = {"short": res["short_outputs"],
+                         "long": res["long_outputs"]}
+
+    # -- disaggregated pair: the SAME decode server config, prefill
+    # offloaded to its own replica process, router in front ------------
+    def prefill_argv(port: int) -> list[str]:
+        cmd = [sys.executable, "-m", "theanompi_tpu.frontdoor.prefill",
+               "--export-dir", export_dir, "--host", "127.0.0.1",
+               "--port", str(port),
+               "--page-size", str(args.decode_page_size),
+               "--pages-per-seq", str(args.decode_pages_per_seq),
+               "--max-seqs", str(args.decode_max_seqs),
+               "--max-pending", str(args.prefill_max_pending)]
+        if args.decode_prefill_buckets:
+            cmd += ["--prefill-buckets", args.decode_prefill_buckets]
+        if args.prefill_nice and shutil.which("nice"):
+            # in production the roles sit on SEPARATE hosts; on a
+            # shared CI box the OS timeslices them over the same
+            # cores, so a prefill burst would steal cycles from
+            # mid-flight decode steps — the exact coupling
+            # disaggregation removes.  Deprioritizing the prefill
+            # fleet restores the isolation: decode preempts promptly
+            # and prefill runs in the gaps (long TTFT pays, short
+            # intertoken doesn't — the disaggregation trade, made
+            # explicit).  The single-role legs can't be helped this
+            # way: their prefill runs INSIDE the decode loop.
+            cmd = ["nice", "-n", str(args.prefill_nice)] + cmd
+        return cmd
+
+    print("[mixed-trace] booting the prefill replica (subprocess) ...",
+          flush=True)
+    prefill_group = RoleGroup("prefill", prefill_argv, initial=1)
+    try:
+        for name, leg_longs in (("disagg_short", []),
+                                ("disagg_mixed", longs)):
+            print(f"[mixed-trace] leg {name} ...", flush=True)
+            server, sthread, decode_addr = _start_decode_server(
+                export_dir, args, None, prefix_cache=True)
+            router = Router(prefill=prefill_group.addresses(),
+                            decode=[decode_addr])
+            rport = _free_port()
+            ready, rstop = threading.Event(), threading.Event()
+            rthread = threading.Thread(
+                target=router_mod.serve, daemon=True,
+                kwargs=dict(router=router, host="127.0.0.1",
+                            port=rport, ready_event=ready,
+                            stop_event=rstop))
+            rthread.start()
+            assert ready.wait(30), "router never came up"
+            raddr = f"127.0.0.1:{rport}"
+            try:
+                res = _measure_mixed_leg(
+                    lambda: RouterClient(raddr), server, warm_long,
+                    warm_shorts, shorts, leg_longs, args)
+                rst = router.stats()
+                probe = InferenceClient(decode_addr)
+                st = probe.stats()
+                probe.shutdown()
+                probe.close()
+            finally:
+                rstop.set()
+                rthread.join(timeout=10)
+                router.close()
+                server.stop()
+                sthread.join(timeout=10)
+            legs[name] = _mixed_leg_summary(res, st)
+            legs[name]["router"] = {k: rst.get(k) for k in
+                                    ("streams", "shed", "failovers")}
+            outputs[name] = {"short": res["short_outputs"],
+                             "long": res["long_outputs"]}
+    finally:
+        prefill_group.stop()
+
+    p99 = {name: (leg.get("intertoken_ms") or {}).get("p99")
+           for name, leg in legs.items()}
+    ratio = lambda a, b: (p99[a] / p99[b]
+                          if p99.get(a) and p99.get(b) else None)
+    ratios = {
+        "single_mixed_over_short": ratio("single_mixed",
+                                         "single_short"),
+        "disagg_mixed_over_short": ratio("disagg_mixed",
+                                         "disagg_short"),
+    }
+    byte_identity = {
+        # migration alone (no long-prompt interference) ...
+        "disagg_short_vs_single_short": _outputs_identical(
+            outputs["disagg_short"]["short"],
+            outputs["single_short"]["short"]),
+        # ... and under the mixed load, short and long streams both
+        "disagg_mixed_vs_single_short": _outputs_identical(
+            outputs["disagg_mixed"]["short"],
+            outputs["single_short"]["short"]),
+        "disagg_mixed_long_vs_single_mixed": _outputs_identical(
+            outputs["disagg_mixed"]["long"],
+            outputs["single_mixed"]["long"]),
+    }
+    out = {
+        "bench": "serving",
+        "mode": "mixed-trace",
+        "decode": True,
+        "argv": sys.argv[1:],
+        "workload": {
+            "short_streams": len(shorts),
+            "short_prompt_tokens": args.prompt_tokens,
+            "short_gen_tokens": args.gen_tokens,
+            "long_arrivals": len(longs),
+            "long_prompt_tokens": args.long_prompt_tokens,
+            "long_gen_tokens": args.long_gen_tokens,
+            "rate_rps": args.rate,
+            "long_every_s": args.long_every_s,
+        },
+        "model": {"net": meta.get("net"),
+                  "weight_dtype": meta.get("weight_dtype")},
+        "legs": legs,
+        "intertoken_p99_ms": p99,
+        "ratios": ratios,
+        "byte_identity": byte_identity,
+        "acceptance": {
+            "single_role_degrades_3x": (
+                ratios["single_mixed_over_short"] is not None
+                and ratios["single_mixed_over_short"] >= 3.0),
+            "disagg_holds_1p3x": (
+                ratios["disagg_mixed_over_short"] is not None
+                and ratios["disagg_mixed_over_short"] <= 1.3),
+            "byte_identical_migrated_output": all(
+                v["identical"] for v in byte_identity.values()),
+        },
+    }
+    if args.scale_drill:
+        print("[mixed-trace] scale drill (subprocess fleet, "
+              "autoscaler on) ...", flush=True)
+        monitor_dir = args.monitor_dir or os.path.join(
+            tmp_dir, "monitor")
+        out["scale_drill"] = _scale_drill(export_dir, args,
+                                          monitor_dir)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--addr", default=None,
@@ -497,11 +1008,16 @@ def main(argv=None) -> int:
     ap.add_argument("--demo", action="store_true",
                     help="export an untrained TinyCifar to a temp dir "
                          "first (self-contained CPU run)")
-    ap.add_argument("--mode", choices=("closed", "open", "trace"),
+    ap.add_argument("--mode",
+                    choices=("closed", "open", "trace", "mixed-trace"),
                     default="closed",
-                    help="closed/open loop, or 'trace' — the decode "
+                    help="closed/open loop, 'trace' — the decode "
                          "prompt-heavy trace (shared prefix x many "
-                         "streams, per-stream tok/s)")
+                         "streams, per-stream tok/s) — or "
+                         "'mixed-trace' — the disaggregation workload "
+                         "(open-loop short chat + periodic long "
+                         "prompts; single-role vs disaggregated "
+                         "inter-token p99)")
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--rate", type=float, default=100.0,
                     help="open-loop arrival rate, req/s")
@@ -547,6 +1063,40 @@ def main(argv=None) -> int:
                          "trace")
     ap.add_argument("--concurrency", type=int, default=8,
                     help="--mode trace: max streams in flight")
+    ap.add_argument("--short-streams", type=int, default=40,
+                    help="--mode mixed-trace: short-chat arrivals in "
+                         "the schedule (prompts = --prompt-tokens, "
+                         "generation = --gen-tokens, Poisson at "
+                         "--rate)")
+    ap.add_argument("--long-prompt-tokens", type=int, default=224,
+                    help="--mode mixed-trace: prompt length of the "
+                         "periodic long arrivals (the compute-bound "
+                         "prefill)")
+    ap.add_argument("--long-gen-tokens", type=int, default=2,
+                    help="--mode mixed-trace: tokens generated per "
+                         "long stream")
+    ap.add_argument("--long-every-s", type=float, default=0.5,
+                    help="--mode mixed-trace: long-arrival period")
+    ap.add_argument("--prefill-max-pending", type=int, default=8,
+                    help="--mode mixed-trace: the prefill replica's "
+                         "admission bound")
+    ap.add_argument("--prefill-nice", type=int, default=5,
+                    help="--mode mixed-trace: CPU niceness for the "
+                         "prefill subprocess — emulates the separate "
+                         "host the prefill role gets in production, "
+                         "so a shared CI box's timeslicing doesn't "
+                         "charge prefill bursts to decode intertoken "
+                         "(0 = share the cores as-is)")
+    ap.add_argument("--scale-drill", action="store_true",
+                    help="--mode mixed-trace: append the autoscaler "
+                         "leg — a real subprocess fleet hammered past "
+                         "its prefill admission bound until scale-up "
+                         "executes (monitor JSONL lands in "
+                         "--monitor-dir)")
+    ap.add_argument("--monitor-dir", default=None,
+                    help="--scale-drill: directory for the drill's "
+                         "monitor metrics JSONL (default: a temp dir, "
+                         "i.e. discarded)")
     ap.add_argument("--speculate-k", type=int, default=4,
                     help="draft tokens per speculative round")
     ap.add_argument("--draft-export-dir", default=None,
@@ -577,13 +1127,14 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
 
-    if args.mode == "trace":
+    if args.mode in ("trace", "mixed-trace"):
         if not args.decode:
-            ap.error("--mode trace is a --decode mode")
+            ap.error(f"--mode {args.mode} is a --decode mode")
         import tempfile
 
         with tempfile.TemporaryDirectory() as td:
-            out = trace_main(args, td)
+            out = (trace_main(args, td) if args.mode == "trace"
+                   else mixed_main(args, td))
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)
         print(json.dumps(out, indent=1))

@@ -454,6 +454,115 @@ PYEOF
   DECODE_RC=$?
   rm -rf "$DECODEDIR"
   echo "decode smoke rc=$DECODE_RC"
+  echo "## frontdoor smoke (disaggregated fleet: router + 1 prefill + 1 decode REAL processes, docs/SERVING.md 'Disaggregated serving')"
+  # the ISSUE 17 vertical end-to-end: DisaggregatedFleet spawns a real
+  # prefill subprocess and a real decode subprocess, router in the
+  # parent; three CONCURRENT client streams generate through the
+  # front door (prompt phase on the prefill replica, pages migrated
+  # over wire v2, token phase on the decode replica).  The gate
+  # asserts greedy determinism across identical prompts, zero sheds,
+  # and — via the collector file — that ONE client_generate trace
+  # stitches >= 3 PROCESSES with zero orphans and carries the
+  # page_migrate span; tools/traces.py --require-procs 3 then
+  # confirms the same from the merged stream and prints the critical
+  # path
+  FRONTDIR="$(mktemp -d)"
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$FRONTDIR" python - <<'PYEOF'
+import os, sys, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+os.environ["THEANOMPI_TPU_TRACE"] = "1"  # before any child spawns
+from theanompi_tpu import monitor
+from theanompi_tpu.frontdoor.fleet import DisaggregatedFleet
+from theanompi_tpu.frontdoor.router import RouterClient
+from theanompi_tpu.models.base import ModelConfig
+from theanompi_tpu.models.transformer import TransformerLM
+from theanompi_tpu.monitor.collector import CollectorProcess
+from theanompi_tpu.serving import export_model
+
+mondir = os.environ["THEANOMPI_TPU_MONITOR"]
+cfg = ModelConfig(batch_size=4, n_epochs=1, print_freq=0,
+                  compute_dtype="float32", optimizer="adamw",
+                  learning_rate=1e-3, weight_decay=0.0,
+                  lr_schedule="constant")
+lm = TransformerLM(config=cfg, vocab=32, seq_len=32, n_layers=2,
+                   d_model=16, n_heads=2, verbose=False)
+export_dir = os.path.join(mondir, "export")
+export_model(lm, export_dir, version=0)
+col = CollectorProcess(mondir)  # exports THEANOMPI_TPU_COLLECTOR
+try:
+    with monitor.session(run_dir=mondir, stall_after=float("inf")), \
+         DisaggregatedFleet(export_dir, prefill=1, decode=1,
+                            router_host="127.0.0.1", page_size=4,
+                            pages_per_seq=8, max_seqs=4,
+                            prefill_buckets=(8,)) as fleet:
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 32, 5).astype(np.int32)
+                   for _ in range(2)]
+        prompts.append(prompts[0].copy())  # greedy-determinism pair
+        outs = [None] * 3
+
+        def gen(i):
+            c = RouterClient(fleet.router_addr)
+            try:
+                with monitor.span("client_generate"):
+                    outs[i] = c.generate(prompts[i], 6)
+            finally:
+                c.close()
+
+        ths = [threading.Thread(target=gen, args=(i,))
+               for i in range(3)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(180)
+        assert all(o is not None and len(o) == 6 for o in outs), outs
+        assert list(outs[0]) == list(outs[2]), (outs[0], outs[2])
+        c = RouterClient(fleet.router_addr)
+        st = c.stats()
+        c.close()
+        assert st["streams"] >= 3 and st["shed"] == 0, st
+        time.sleep(2.0)  # let the role exporters flush their tails
+    # the fleet file now carries client+router / prefill / decode
+    cst = col.stats()
+    assert cst and cst["events"] > 0 and cst["senders"] >= 3, cst
+    sys.path.insert(0, os.path.join(os.getcwd(), "tools"))
+    import traces as traces_tool
+    records = traces_tool.load_events(os.path.join(mondir,
+                                                   "fleet.jsonl"))
+    tr = traces_tool.assemble(records)
+    gen_tr = [s for s in tr.values()
+              if any(x["name"] == "client_generate" for x in s)]
+    assert gen_tr, "no client_generate trace reached the collector"
+    full = [s for s in gen_tr
+            if len(traces_tool.processes_of(s)) >= 3
+            and not traces_tool.orphans(s)]
+    assert full, [(len(s), sorted(traces_tool.processes_of(s)),
+                   len(traces_tool.orphans(s))) for s in gen_tr]
+    names = [x["name"] for x in full[0]]
+    assert any("page_migrate" in n for n in names), names
+    print(f"frontdoor smoke OK: {st['streams']} streams through "
+          f"router+prefill+decode, stitched trace spans "
+          f"{len(traces_tool.processes_of(full[0]))} processes "
+          f"({len(full[0])} spans, 0 orphans, page_migrate present)")
+finally:
+    col.stop()
+PYEOF
+  FRONTDOOR_RC=$?
+  if [ "$FRONTDOOR_RC" -eq 0 ]; then
+    # the consumer tool over the SAME merged file: traces.py must
+    # confirm the >=3-process orphan-free trace and print its
+    # critical path
+    python tools/traces.py "$FRONTDIR" --require-procs 3 \
+      > "$FRONTDIR/traces.out" 2>&1
+    FTRACES_RC=$?
+    grep -q "critical path" "$FRONTDIR/traces.out" || FTRACES_RC=1
+    sed -n '1,8p' "$FRONTDIR/traces.out"
+    FRONTDOOR_RC=$FTRACES_RC
+  fi
+  rm -rf "$FRONTDIR"
+  echo "frontdoor smoke rc=$FRONTDOOR_RC"
   echo "## exchange-bench smoke (wire v1 vs v2 over real sockets, docs/DESIGN.md 'Wire protocol v2')"
   # the comms vertical end-to-end: drive the ~25M-param ResNet-50-sized
   # tree through the param service in every protocol x compression x
@@ -560,7 +669,7 @@ PYEOF
   RPC_RC=$?
   rm -rf "$RPCDIR"
   echo "rpc smoke rc=$RPC_RC"
-  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$COLLECTOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$BUCKET_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$HIER_RC" -ne 0 ] || [ "$SOAK_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
+  if [ "$TMLINT_RC" -ne 0 ] || [ "$GATE_RC" -ne 0 ] || [ "$PYTEST_RC" -ne 0 ] || [ "$ENTRY_RC" -ne 0 ] || [ "$MONITOR_RC" -ne 0 ] || [ "$COLLECTOR_RC" -ne 0 ] || [ "$RESILIENCE_RC" -ne 0 ] || [ "$SERVING_RC" -ne 0 ] || [ "$DECODE_RC" -ne 0 ] || [ "$FRONTDOOR_RC" -ne 0 ] || [ "$EXCHANGE_RC" -ne 0 ] || [ "$BUCKET_RC" -ne 0 ] || [ "$SHARD_RC" -ne 0 ] || [ "$HIER_RC" -ne 0 ] || [ "$SOAK_RC" -ne 0 ] || [ "$INGEST_RC" -ne 0 ] || [ "$RPC_RC" -ne 0 ]; then
     echo "PREFLIGHT: FAIL"
     [ "$TMLINT_RC" -ne 0 ] && echo "PREFLIGHT: tmlint --gate found NEW findings — fix or baseline with a reason (docs/ANALYSIS.md)"
     [ "$GATE_RC" -ne 0 ] && echo "PREFLIGHT: the -m gate subset itself failed — do NOT snapshot"

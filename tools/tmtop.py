@@ -3,7 +3,10 @@
 
 Tails ``fleet.jsonl`` (the collector's merged stream; every exporter
 ships a metrics snapshot event every couple of seconds) and renders
-one row per fleet process: step rate and p50, exchange / RPC p99s,
+one row per fleet process: the fleet role (router / prefill / serve /
+ingest / ... — derived from the exporter-name prefix, so a
+disaggregated serving fleet reads at a glance), step rate and p50,
+exchange / RPC p99s,
 decode queue depth and overload count, exporter drop counter, and
 restart counters — the "is the fleet healthy and busy" question at a
 glance, without ssh-ing into K processes to read K files.
@@ -96,7 +99,8 @@ class Fleet:
                 return agg(vals) if vals else default
 
             out.append({
-                "role": role, "pid": pid, "rank": rec.get("rank"),
+                "role": role, "fleet": fleet_of(role), "pid": pid,
+                "rank": rec.get("rank"),
                 "age_s": time.time() - float(rec.get("t_wall") or 0),
                 "rate": self.rates.get((role, pid)),
                 "step_p50": series("step_ms", "p50"),
@@ -119,6 +123,22 @@ class Fleet:
         return out
 
 
+# fleet roles, by exporter-name prefix (the monitor session names:
+# router{pid}, prefill{pid}, serve{pid}, ingest_reader{i}_{pid}, ...).
+# "service" before "serve": service{pid} is the param service, not a
+# serving replica.  Anything unrecognized (rank0 trainers) is "train".
+_FLEET_PREFIXES = ("router", "prefill", "service", "serve", "ingest",
+                   "shard", "collector", "aggregate")
+
+
+def fleet_of(role) -> str:
+    r = str(role or "")
+    for p in _FLEET_PREFIXES:
+        if r.startswith(p):
+            return p
+    return "train"
+
+
 def _fmt(v, spec="{:.1f}") -> str:
     if v is None:
         return "-"
@@ -129,7 +149,8 @@ def _fmt(v, spec="{:.1f}") -> str:
 
 def render(rows: list[dict], path: str, file=None) -> None:
     file = file if file is not None else sys.stdout
-    cols = [("role", 18), ("pid", 7), ("rank", 4), ("age", 6),
+    cols = [("role", 18), ("fleet", 9), ("pid", 7), ("rank", 4),
+            ("age", 6),
             ("step/s", 7), ("p50ms", 8), ("exch p99", 9),
             ("rpc p99", 8), ("queue", 6), ("ovld", 5), ("drops", 6),
             ("rst", 4)]
@@ -137,7 +158,8 @@ def render(rows: list[dict], path: str, file=None) -> None:
           f"{len(rows)} processes", file=file)
     print(" ".join(f"{name:>{w}}" for name, w in cols), file=file)
     for r in rows:
-        vals = [str(r["role"])[:18], _fmt(r["pid"], "{}"),
+        vals = [str(r["role"])[:18], r["fleet"],
+                _fmt(r["pid"], "{}"),
                 _fmt(r["rank"], "{}"), _fmt(r["age_s"], "{:.0f}"),
                 _fmt(r["rate"], "{:.2f}"), _fmt(r["step_p50"]),
                 _fmt(r["exch_p99"]), _fmt(r["rpc_p99"]),
