@@ -1120,3 +1120,215 @@ class TestDraftServing:
                 decode_opts=dict(page_size=4, pages_per_seq=8,
                                  max_seqs=4, prefill_buckets=(8,),
                                  draft_export_dir=draft_dir))
+
+
+# ---------------------------------------------------------------------------
+# Batched prefill (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedPrefill:
+    def _session(self, tiny_lm, **over):
+        model, params, _ = tiny_lm
+        opts = dict(page_size=4, pages_per_seq=4, max_seqs=4,
+                    prefill_buckets=(4, 8))
+        opts.update(over)
+        return model, params, DecodeSession(model, params=params,
+                                            **opts)
+
+    def test_batch_identity_across_buckets_zero_recompiles(
+            self, tiny_lm):
+        """Every (n_seqs, token) bucket pair: a batched admission's
+        rows decode token-identically to the uncached oracle (= the
+        serial admit path's own identity anchor), and after
+        ``warmup_prefill_batch`` no batch shape compiles anything."""
+        model, params, sess = self._session(tiny_lm,
+                                            prefix_cache=False)
+        sess.warmup()
+        sess.warmup_prefill_batch()
+        warm = dict(sess.compiles)
+        rng = np.random.default_rng(30)
+        # n straddles the n_seqs buckets (1, 2, 4); lengths straddle
+        # the token buckets (4, 8) inside one batch
+        for lens in ((3,), (4, 5), (3, 4, 8), (2, 4, 5, 8)):
+            prompts = [rng.integers(0, VOCAB, t).astype(np.int32)
+                       for t in lens]
+            admitted = sess.admit_batch(prompts)
+            seqs = [s for s, _ in admitted]
+            outs = [[int(np.argmax(lg))] for _, lg in admitted]
+            for _ in range(3):
+                lg = sess.decode(seqs, np.asarray(
+                    [o[-1] for o in outs], np.int32))
+                for i, o in enumerate(outs):
+                    o.append(int(np.argmax(lg[i])))
+            for p, o in zip(prompts, outs):
+                assert o == _flax_greedy(model, params, p, 4)
+            for s in seqs:
+                sess.release(s)
+        # the decode calls above touch their own (unwarmed) n-seq
+        # buckets; the batched-prefill pin is the prefill families
+        for fam in ("prefill", "prefill_batch", "extend"):
+            assert sess.compiles[fam] == warm[fam], (
+                f"{fam} recompiled: {warm} -> {sess.compiles}")
+
+    def test_mixed_cold_and_hit_rows_share_pages(self, tiny_lm):
+        """One batch carries a prefix-cache HIT row (extend from a
+        start offset) and a COLD row (start 0): the hit aliases the
+        cached page, the cold row fills fresh pages, both rows decode
+        token-identically."""
+        model, params, sess = self._session(tiny_lm)
+        rng = np.random.default_rng(31)
+        base = rng.integers(0, VOCAB, 4).astype(np.int32)
+        seed, _ = sess.admit(np.concatenate(
+            [base, rng.integers(0, VOCAB, 1).astype(np.int32)]))
+        ph = np.concatenate(
+            [base, rng.integers(0, VOCAB, 2).astype(np.int32)])
+        pcold = rng.integers(0, VOCAB, 6).astype(np.int32)
+        hits0 = sess.prefix_cache.hits
+        (sh, lh), (sc, lc) = sess.admit_batch([ph, pcold])
+        assert sess.prefix_cache.hits == hits0 + 1
+        assert int(sh.page_row[0]) == int(seed.page_row[0])  # aliased
+        assert int(sc.page_row[0]) != int(seed.page_row[0])
+        oh, oc = [int(np.argmax(lh))], [int(np.argmax(lc))]
+        for _ in range(5):
+            lg = sess.decode([sh, sc],
+                             np.asarray([oh[-1], oc[-1]], np.int32))
+            oh.append(int(np.argmax(lg[0])))
+            oc.append(int(np.argmax(lg[1])))
+        assert oh == _flax_greedy(model, params, ph, 6)
+        assert oc == _flax_greedy(model, params, pcold, 6)
+
+    def test_cow_when_two_batch_rows_share_a_page(self, tiny_lm):
+        """Two rows of ONE batch alias the same cached prefix page;
+        decoding past the ring window writes into it -> COW un-shares
+        each row privately, both match the sliding-window oracle."""
+        model, params, sess = self._session(tiny_lm, pages_per_seq=2,
+                                            prefill_buckets=(8,))
+        rng = np.random.default_rng(32)
+        base = rng.integers(0, VOCAB, 5).astype(np.int32)
+        seed, _ = sess.admit(base)        # registers base[:4]
+        sess.release(seed)
+        pa = np.concatenate(
+            [base[:4], rng.integers(0, VOCAB, 1).astype(np.int32)])
+        pb = np.concatenate(
+            [base[:4], rng.integers(0, VOCAB, 2).astype(np.int32)])
+        (sa, la), (sb, lb) = sess.admit_batch([pa, pb])
+        shared = int(sa.page_row[0])
+        assert shared == int(sb.page_row[0])
+        assert sess.pool.refcount(shared) == 3   # cache + both rows
+        oa, ob = [int(np.argmax(la))], [int(np.argmax(lb))]
+        for _ in range(11):               # crosses the window-8 wrap
+            lg = sess.decode([sa, sb],
+                             np.asarray([oa[-1], ob[-1]], np.int32))
+            oa.append(int(np.argmax(lg[0])))
+            ob.append(int(np.argmax(lg[1])))
+        assert oa == _windowed_greedy(params, pa, 12, 8)
+        assert ob == _windowed_greedy(params, pb, 12, 8)
+        assert sess.cow_copies >= 2
+        assert int(sa.page_row[0]) != int(sb.page_row[0])  # diverged
+
+    def test_allocation_pressure_evicts_mid_batch(self, tiny_lm):
+        """A batch whose rows outnumber the free pages evicts LRU
+        prefix entries row by row instead of failing — and the
+        admitted rows still decode correctly."""
+        model, params, sess = self._session(tiny_lm, pages_per_seq=2,
+                                            prefill_buckets=(8,))
+        rng = np.random.default_rng(33)
+        for _ in range(4):                # 4 one-page orphan entries
+            s, _ = sess.admit(rng.integers(0, VOCAB, 5)
+                              .astype(np.int32))
+            sess.release(s)
+        assert len(sess.prefix_cache) == 4
+        assert sess.pool.free_pages == 4  # of n_pages=8
+        prompts = [rng.integers(0, VOCAB, 5).astype(np.int32)
+                   for _ in range(3)]
+        admitted = sess.admit_batch(prompts)    # needs 6 pages
+        assert sess.prefix_cache.evictions >= 1
+        seqs = [s for s, _ in admitted]
+        outs = [[int(np.argmax(lg))] for _, lg in admitted]
+        for _ in range(2):                # stays inside window 8
+            lg = sess.decode(seqs, np.asarray(
+                [o[-1] for o in outs], np.int32))
+            for i, o in enumerate(outs):
+                o.append(int(np.argmax(lg[i])))
+        for p, o in zip(prompts, outs):
+            assert o == _flax_greedy(model, params, p, 3)
+        # nothing leaked: once the rows release and the cache drops
+        # its refs, every page is free again
+        for s in seqs:
+            sess.release(s)
+        sess.prefix_cache.evict_all()
+        assert sess.pool.free_pages == sess.cfg.n_pages
+
+    def test_failed_batch_leaks_no_pages(self, tiny_lm):
+        """A batch refused mid-validation (one over-long prompt)
+        unwinds every already-taken page reference."""
+        model, params, sess = self._session(tiny_lm)
+        rng = np.random.default_rng(35)
+        free0 = sess.pool.free_pages
+        good = rng.integers(0, VOCAB, 5).astype(np.int32)
+        bad = rng.integers(0, VOCAB, 9).astype(np.int32)  # > bucket 8
+        with pytest.raises(ValueError, match="prompt length"):
+            sess.admit_batch([good, bad])
+        assert sess.pool.free_pages == free0
+
+
+class TestDrainMigration:
+    def test_drained_stream_resumes_byte_identical(self, tiny_lm):
+        """Scale-down drain: a mid-flight stream leaves the batcher as
+        a MigratedStream (emitted tokens + resume manifest + pages); a
+        survivor batcher adopts it and the stitched output is
+        byte-identical to one uninterrupted stream.  The draining
+        batcher refuses new work with the typed Overloaded."""
+        from theanompi_tpu.decode.scheduler import MigratedStream
+
+        model, params, _ = tiny_lm
+
+        def mk():
+            return DecodeSession(model, params=params, page_size=4,
+                                 pages_per_seq=4, max_seqs=2,
+                                 prefill_buckets=(8,))
+
+        rng = np.random.default_rng(34)
+        prompt = rng.integers(0, VOCAB, 3).astype(np.int32)
+        ref = _flax_greedy(model, params, prompt, 13)
+
+        # no scheduler thread: pump by hand so the drain lands at a
+        # deterministic point (4 emitted, the stream mid-flight)
+        b = ContinuousBatcher(mk(), DecodePolicy(max_pending=4,
+                                                 prefill_delay_ms=0.0))
+        res = {}
+        t = threading.Thread(
+            target=lambda: res.setdefault("out",
+                                          b.generate(prompt, 13)))
+        t.start()
+        import time
+        for _ in range(2000):
+            if b._pending:
+                break
+            time.sleep(0.002)
+        b._admit()
+        for _ in range(3):
+            b._step()
+        b._draining = True
+        b._migrate_out()
+        t.join(30)
+        out = res["out"]
+        assert isinstance(out, MigratedStream)
+        # the pending (un-resumed) token rides the manifest, not the
+        # emitted list
+        assert out.tokens == ref[:3]
+        assert out.manifest["first_token"] == ref[3]
+        with pytest.raises(Overloaded, match="draining"):
+            b.generate(prompt, 2)
+        st = b.stats()
+        assert st["drain_migrated"] == 1 and st["draining"]
+
+        survivor = ContinuousBatcher(
+            mk(), DecodePolicy(max_pending=4)).start()
+        try:
+            rest = survivor.generate_adopted(
+                out.manifest, out.k, out.v, 13 - len(out.tokens))
+            assert out.tokens + [int(x) for x in rest] == ref
+        finally:
+            survivor.stop()

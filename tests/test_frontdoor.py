@@ -674,3 +674,153 @@ def test_disaggregated_fleet_subprocess_roundtrip(tiny_lm):
             assert st["streams"] == 1 and st["shed"] == 0
         finally:
             c.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet prefix cache (ISSUE 18): authority ops + lease refusal matrix
+# ---------------------------------------------------------------------------
+
+
+class TestFleetCache:
+    def test_register_then_lookup_ships_identical_bytes(self, stack,
+                                                        tiny_lm):
+        """A peer registers a page-aligned prefix over the wire; the
+        next lookup ships back byte-identical pages under a lease."""
+        from theanompi_tpu.decode import DecodeSession, fleetcache
+
+        model, params, _ = tiny_lm
+        sess = DecodeSession(model, params=params, **GEO)
+        c = fleetcache.FleetCacheClient(stack["prefill"])
+        try:
+            rng = np.random.default_rng(41)
+            prompt = rng.integers(0, VOCAB, 8).astype(np.int32)
+            assert c.lookup(prompt) is None          # cold fleet
+            seq, _ = sess.admit(prompt)
+            k, v = sess.export_page_ids([int(seq.page_row[0])])
+            man = fleetcache.prefix_manifest(sess.cfg, prompt[:4])
+            assert c.register_prefix(man, k, v)["added"] is True
+            got = c.lookup(prompt)
+            assert got is not None
+            m2, k2, v2, lease = got
+            assert m2["n_tokens"] == 4
+            assert m2["prefix"] == [int(t) for t in prompt[:4]]
+            np.testing.assert_array_equal(k2, k)
+            np.testing.assert_array_equal(v2, v)
+            c.decref(lease)
+            sess.release(seq)
+        finally:
+            c.close()
+
+    def test_lease_refusal_matrix_over_wire(self, stack):
+        """Foreign lease and double decref raise the typed LeaseError;
+        a geometry-lying register raises IncompatiblePages; the same
+        client connection (and the authority) keep serving."""
+        from theanompi_tpu.decode import fleetcache
+
+        pre = stack["prefill_server"]
+        c = fleetcache.FleetCacheClient(stack["prefill"])
+        try:
+            with pytest.raises(fleetcache.LeaseError, match="lease"):
+                c.decref("lease-0-999999")           # foreign
+            rng = np.random.default_rng(42)
+            prompt = rng.integers(0, VOCAB, 8).astype(np.int32)
+            pre.prefill(prompt)       # cold prefill seeds the cache
+            man, k, v, lease = c.lookup(prompt)
+            c.decref(lease)
+            with pytest.raises(fleetcache.LeaseError, match="lease"):
+                c.decref(lease)                      # double decref
+            bad = dict(man, page_size=8)
+            with pytest.raises(IncompatiblePages, match="page_size"):
+                c.register_prefix(bad, np.asarray(k), np.asarray(v))
+            # same connection: the authority still answers
+            got = c.lookup(prompt)
+            assert got is not None
+            c.decref(got[3])
+        finally:
+            c.close()
+
+    def test_evict_while_leased_pages_survive(self, stack):
+        """Remote eviction can never free a shipped page mid-flight:
+        the lease's reference keeps it allocated until decref."""
+        pre = stack["prefill_server"]
+        sess = pre.session
+        rng = np.random.default_rng(43)
+        prompt = rng.integers(0, VOCAB, 8).astype(np.int32)
+        pre.prefill(prompt)
+        got = pre.cache_lookup(prompt)
+        assert got is not None
+        _, _, lease = got
+        page_ids = list(pre._leases[lease])
+        with pre._lock:
+            sess.prefix_cache.evict_all()    # cache refs dropped
+        assert all(sess.pool.refcount(p) >= 1 for p in page_ids)
+        pre.cache_decref(lease)
+        assert all(sess.pool.refcount(p) == 0 for p in page_ids)
+
+    def test_cross_replica_fleet_hit_end_to_end(self, stack, tiny_lm):
+        """A session that attaches the authority as its fleet cache
+        turns a local miss into an adopted local hit (and registers
+        its own cold prefixes back): both directions, with the decoded
+        stream token-identical to the oracle and no leaked lease."""
+        from theanompi_tpu.decode import DecodeSession, fleetcache
+
+        model, params, _ = tiny_lm
+        pre = stack["prefill_server"]
+        rng = np.random.default_rng(44)
+        prompt = rng.integers(0, VOCAB, 8).astype(np.int32)
+        pre.prefill(prompt)          # authority caches prompt[:4]
+        sess = DecodeSession(model, params=params, **GEO)
+        sess.fleet = fleetcache.FleetCacheClient(stack["prefill"])
+        try:
+            leases0 = len(pre._leases)
+            seq, lg = sess.admit(prompt)   # miss -> fetch -> local hit
+            assert sess.prefix_cache.hits == 1
+            assert len(pre._leases) == leases0     # fetch decrefs
+            out = [int(np.argmax(lg))]
+            for _ in range(5):
+                l2 = sess.decode([seq],
+                                 np.asarray([out[-1]], np.int32))
+                out.append(int(np.argmax(l2[0])))
+            assert out == _flax_greedy(model, params, prompt, 6)
+            # reverse direction: a cold admit registers its prefix
+            p2 = rng.integers(0, VOCAB, 8).astype(np.int32)
+            sess.admit(p2)
+            got = pre.cache_lookup(p2)
+            assert got is not None and got[0]["n_tokens"] == 4
+            pre.cache_decref(got[2])
+        finally:
+            sess.fleet.close()
+
+
+class TestPrefillCoalescing:
+    def test_concurrent_prefills_coalesce_into_one_batch(self,
+                                                         tiny_lm):
+        """4 concurrent prefill() calls ride ONE batched program (the
+        leader waits out the oldest prompt's deadline) and each caller
+        gets pages/manifest byte-identical to the serial cap-1 path."""
+        model, params, export_dir = tiny_lm
+        pre = PrefillServer(export_dir, model=model, max_pending=8,
+                            warmup=False, prefill_delay_ms=250.0,
+                            **GEO)
+        rng = np.random.default_rng(45)
+        prompts = [rng.integers(0, VOCAB, 6 + i % 3).astype(np.int32)
+                   for i in range(4)]
+        results = [None] * 4
+
+        def run(i):
+            results[i] = pre.prefill(prompts[i])
+
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        assert pre.n_batches == 1 and pre.n_prefills == 4
+        serial = PrefillServer(export_dir, model=model, max_pending=8,
+                               warmup=False, prefill_batch=1, **GEO)
+        for p, (man, pages) in zip(prompts, results):
+            rman, rpages = serial.prefill(p)
+            assert man == rman
+            np.testing.assert_array_equal(pages[0], rpages[0])
+            np.testing.assert_array_equal(pages[1], rpages[1])

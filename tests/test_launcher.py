@@ -82,7 +82,9 @@ def test_parser_serve_decode_mode(monkeypatch, tmp_path):
                          "--decode-pages-per-seq", "2",
                          "--decode-max-seqs", "16",
                          "--decode-max-pending", "64",
-                         "--decode-prefill-buckets", "8,32"])
+                         "--decode-prefill-buckets", "8,32",
+                         "--decode-prefill-batch", "4",
+                         "--decode-prefill-delay-ms", "1.5"])
     assert args.decode and args.decode_page_size == 4
     seen = {}
 
@@ -96,7 +98,8 @@ def test_parser_serve_decode_mode(monkeypatch, tmp_path):
     assert seen["decode_opts"] == {
         "page_size": 4, "pages_per_seq": 2, "max_seqs": 16,
         "max_pending": 64, "prefill_buckets": (8, 32),
-        "prefix_cache": True}
+        "prefix_cache": True, "prefill_batch": 4,
+        "prefill_delay_ms": 1.5}
     # default: decode off, opts None
     _run(p.parse_args(["SERVE", "--export-dir", "/tmp/exp"]),
          multihost=False)

@@ -1,0 +1,219 @@
+"""Fleet-wide prefix cache — the prefill fleet as cache authority.
+
+A replica's :class:`~theanompi_tpu.decode.kvcache.PrefixCache` only
+shares prefixes WITHIN its own pool; across the fleet, the same system
+prompt still prefills once per replica.  This module closes that gap:
+one prefill replica (the AUTHORITY — replica 0 of the prefill role
+group, see ``frontdoor/fleet.py``) answers three ops over the ordinary
+RPC substrate, and every other replica — prefill peers and decode
+replicas alike — attaches a :class:`FleetCacheClient` to its session
+(``DecodeSession.fleet``):
+
+* ``cache_lookup(prompt)`` — longest page-aligned prefix the authority
+  holds.  A hit INCREFS the entry's pages under a **lease** and ships
+  their bytes as raw wire-v2 frames with a geometry manifest (the
+  migration contract of ``decode/migrate.py``, minus stream state), so
+  remote LRU eviction can never free a page mid-flight: the lease's
+  reference keeps it allocated until the reader decrefs.
+* ``cache_decref(lease_id)`` — drop the lease once the shipped bytes
+  are adopted (or discarded).  An unknown lease — foreign, expired, or
+  already released — raises the typed :class:`LeaseError`, which rides
+  the wire's ``err`` prefix like ``Overloaded``: the refusal matrix in
+  tests/test_frontdoor.py pins foreign-lease / double-decref /
+  evict-while-leased.
+* ``cache_register(manifest, pages)`` — a replica that just COLD-
+  prefilled a prompt offers its longest page-aligned prefix so the
+  NEXT replica to see that prompt hits.  The authority validates
+  geometry (typed ``IncompatiblePages`` refusal) and adopts the bytes
+  as pure cache content (``DecodeSession.adopt_prefix``).
+
+Trust model: the fleet shares one HMAC authkey (the service-key
+discipline every plane uses), so a registered prefix is as trusted as
+a migrated stream — the authority still validates shape/dtype/geometry
+before its pool is touched, and exact-match byte keys mean a poisoned
+ENTRY could only ever be served for the exact prompt bytes that
+registered it.
+
+The client side is deliberately BEST-EFFORT: a fleet-cache transport
+failure counts (``decode/fleet_cache_errors_total``) and degrades to a
+local miss — admission never fails because the authority is down.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from theanompi_tpu import monitor
+from theanompi_tpu.decode.migrate import (
+    GEOMETRY_FIELDS,
+    IncompatiblePages,
+    manifest_incompatibility,
+)
+from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel.service import ServiceClient, ServiceError
+
+
+class LeaseError(RuntimeError):
+    """Typed lease refusal: decref of a lease the authority does not
+    hold (foreign id, double decref, or a lease that never existed).
+    Rides the RPC ``err`` prefix and re-raises as itself client-side —
+    a per-call refusal, the authority keeps serving."""
+
+
+def prefix_manifest(cfg, prefix, version: int = 0) -> dict:
+    """Geometry manifest for shipped PREFIX pages — the
+    ``page_manifest`` contract minus stream state (no length /
+    first_token: a prefix is cache content, not a live stream).
+    ``prefix`` must be page-aligned; its pages travel alongside as raw
+    frames shaped ``(n_layers, n_tokens/page_size, page_size, n_heads,
+    d_head)`` per pool."""
+    prefix = np.asarray(prefix, np.int32).reshape(-1)
+    return {
+        "n_layers": int(cfg.n_layers),
+        "n_heads": int(cfg.n_heads),
+        "d_head": int(cfg.d_head),
+        "page_size": int(cfg.page_size),
+        "pages_per_seq": int(cfg.pages_per_seq),
+        "dtype": str(cfg.dtype),
+        "n_tokens": int(prefix.shape[0]),
+        "prefix": [int(t) for t in prefix],
+        "version": int(version),
+    }
+
+
+def prefix_incompatibility(manifest: dict, k, v, cfg) -> str | None:
+    """Why shipped prefix pages cannot enter a pool shaped by ``cfg``
+    — None when compatible.  Pure check, shared by the authority
+    (before register touches its pool), the fetching replica (before
+    adopt), and the refusal-matrix tests."""
+    if not isinstance(manifest, dict):
+        return f"manifest is {type(manifest).__name__}, not a dict"
+    for f in (*GEOMETRY_FIELDS, "n_tokens", "prefix"):
+        if f not in manifest:
+            return f"prefix manifest missing field {f!r}"
+    for f in GEOMETRY_FIELDS:
+        want = getattr(cfg, f)
+        got = manifest[f]
+        if (str(got) if f == "dtype" else int(got)) != \
+                (str(want) if f == "dtype" else int(want)):
+            return (f"page geometry mismatch on {f}: sender {got!r} "
+                    f"vs receiver {want!r}")
+    n = int(manifest["n_tokens"])
+    ps = int(cfg.page_size)
+    if n < ps or n % ps:
+        return (f"prefix of {n} tokens is not a whole number of "
+                f"{ps}-token pages")
+    q = n // ps
+    if q > int(cfg.pages_per_seq):
+        return (f"prefix spans {q} pages > pages_per_seq "
+                f"{cfg.pages_per_seq}")
+    if len(manifest["prefix"]) != n:
+        return (f"prefix manifest carries {len(manifest['prefix'])} "
+                f"tokens but n_tokens says {n}")
+    shape = (cfg.n_layers, q, ps, cfg.n_heads, cfg.d_head)
+    for name, arr in (("k", k), ("v", v)):
+        arr = np.asarray(arr)
+        if tuple(arr.shape) != shape:
+            return (f"{name} prefix pages shaped {tuple(arr.shape)}, "
+                    f"receiver wants {shape}")
+        if str(arr.dtype) != str(np.dtype(cfg.dtype)):
+            return (f"{name} prefix pages dtype {arr.dtype}, receiver "
+                    f"pool wants {np.dtype(cfg.dtype)}")
+    return None
+
+
+class FleetCacheClient(ServiceClient):
+    """Wire client for the fleet cache authority.
+
+    The low-level ops (:meth:`lookup` / :meth:`decref` /
+    :meth:`register_prefix`) re-raise the typed refusals and propagate
+    transport errors — the refusal-matrix tests drive those.  The
+    session-facing :meth:`fetch` / :meth:`register` wrappers are what
+    ``DecodeSession`` calls on its admission path: best-effort, every
+    failure counted and swallowed, because a down authority must read
+    as a plain cache miss, never a failed admission.
+    """
+
+    #: typed errors that re-raise as themselves off the wire
+    _TYPED = {LeaseError.__name__: LeaseError,
+              IncompatiblePages.__name__: IncompatiblePages}
+
+    def _call_typed(self, op: str, *args):
+        try:
+            return self.call(op, *args)
+        except ServiceError as e:
+            for name, cls in self._TYPED.items():
+                if name in str(e):
+                    raise cls(str(e)) from None
+            raise
+
+    # -- low-level ops --------------------------------------------------
+
+    def lookup(self, prompt):
+        """Authority lookup: ``(manifest, k, v, lease_id)`` on a hit
+        (the lease holds a page reference until :meth:`decref`), None
+        on a miss."""
+        out = self._call_typed("cache_lookup",
+                               np.asarray(prompt, np.int32))
+        if out is None:
+            return None
+        manifest, pages, lease = out
+        k, v = pages          # RawArrays decodes to a plain tuple
+        return manifest, k, v, lease
+
+    def decref(self, lease_id: str) -> None:
+        self._call_typed("cache_decref", str(lease_id))
+
+    def register_prefix(self, manifest: dict, k, v) -> dict:
+        return self._call_typed("cache_register", manifest,
+                                wire.RawArrays(np.asarray(k),
+                                               np.asarray(v)))
+
+    # -- session-facing best-effort wrappers ----------------------------
+
+    def fetch(self, session, prompt) -> bool:
+        """On a LOCAL miss: ask the authority, adopt a hit's shipped
+        pages into ``session``'s prefix cache.  Returns True when an
+        adoption happened (the caller re-resolves locally).  The lease
+        is released in ``finally`` — adopted or not, the authority's
+        page reference never outlives this call."""
+        try:
+            got = self.lookup(prompt)
+        except Exception:
+            monitor.inc("decode/fleet_cache_errors_total")
+            return False
+        if got is None:
+            monitor.inc("decode/fleet_cache_misses_total")
+            return False
+        manifest, k, v, lease = got
+        try:
+            reason = prefix_incompatibility(manifest, k, v, session.cfg)
+            if reason is not None:
+                monitor.inc("decode/fleet_cache_errors_total")
+                return False
+            adopted = session.adopt_prefix(
+                np.asarray(manifest["prefix"], np.int32), k, v)
+            if adopted:
+                monitor.inc("decode/fleet_cache_hits_total")
+                monitor.inc("decode/fleet_cache_ship_bytes_total",
+                            float(np.asarray(k).nbytes
+                                  + np.asarray(v).nbytes))
+            return adopted
+        finally:
+            try:
+                self.decref(lease)
+            except Exception:
+                monitor.inc("decode/fleet_cache_errors_total")
+
+    def register(self, session, prefix, pages) -> None:
+        """Offer a just-prefilled page-aligned prefix (page ids in
+        ``session``'s pool) to the authority.  Best effort — errors
+        are counted, never raised."""
+        try:
+            k, v = session.export_page_ids(pages)
+            manifest = prefix_manifest(session.cfg, prefix,
+                                       version=session.version)
+            self.register_prefix(manifest, k, v)
+            monitor.inc("decode/fleet_cache_registers_total")
+        except Exception:
+            monitor.inc("decode/fleet_cache_errors_total")

@@ -186,8 +186,13 @@ def write_tokens_all(pages, page_rows, lengths, counts, kv):
     batched ``.at[:, page, off].set``; tokens past a sequence's count
     are routed to page id ``n_pages`` and dropped by the scatter —
     which is exactly how REJECTED draft tokens never reach the cache
-    (no rollback needed: nothing was written).  Requires C <= window
-    so a chunk's positions land on distinct slots.
+    (no rollback needed: nothing was written).  Tokens more than
+    ``window`` positions BEHIND a sequence's count are dropped the
+    same way (they are already evicted — the ring wrapped past them),
+    so a count may exceed the window: only the last ``window``
+    positions survive, each on a distinct slot — the batched cold
+    prefill of a window-exceeding prompt is ``ring_from_prompt``'s
+    ``p >= length - window`` filter expressed per row.
     """
     page_size = pages.shape[2]
     window = page_rows.shape[1] * page_size
@@ -196,7 +201,8 @@ def write_tokens_all(pages, page_rows, lengths, counts, kv):
     pos = lengths.astype(jnp.int32)[:, None] + i                 # (S, C)
     slot = jnp.mod(pos, window)
     page = jnp.take_along_axis(page_rows, slot // page_size, axis=1)
-    page = jnp.where(i < counts.astype(jnp.int32)[:, None], page,
+    cnt = counts.astype(jnp.int32)[:, None]
+    page = jnp.where((i < cnt) & (i >= cnt - window), page,
                      pages.shape[1])
     off = jnp.mod(slot, page_size)
     return pages.at[:, page, off].set(kv, mode="drop")
@@ -391,6 +397,45 @@ class PrefixCache:
             pages = [int(p) for p in page_row[:q]]
             self.pool.incref(pages)
             self._entries[key] = _PrefixEntry(pages, q * self.page_size)
+            added += 1
+        return added
+
+    def contains(self, prefix: np.ndarray) -> bool:
+        """Exact-key membership probe (no LRU bump, no hit/miss
+        accounting) — the fleet-cache authority's "already
+        registered?" check before adopting shipped pages."""
+        prefix = np.asarray(prefix, np.int32).reshape(-1)
+        return prefix.tobytes() in self._entries
+
+    def insert_pages(self, prefix: np.ndarray, pages) -> int:
+        """Register a page-aligned prefix whose OWN pages are given
+        explicitly — including the exact full length.  Unlike
+        :meth:`insert` (which registers only PROPER prefixes of a live
+        prompt, because the suffix token's logits must come from a
+        prefill), a fleet-shipped prefix is pure cache content with no
+        live sequence behind it, so its full length is a legal key.
+        Every nested page-aligned sub-prefix registers too; each entry
+        increfs the pages it references.  Returns entries added."""
+        prefix = np.asarray(prefix, np.int32).reshape(-1)
+        n = prefix.shape[0]
+        ps = self.page_size
+        if n < ps or n % ps or n > self.window:
+            raise ValueError(
+                f"insert_pages needs a page-aligned prefix of 1.."
+                f"{self.window // ps} pages, got {n} tokens")
+        pages = [int(p)
+                 for p in np.asarray(pages, np.int64).reshape(-1)]
+        if len(pages) != n // ps:
+            raise ValueError(
+                f"{len(pages)} pages cannot hold {n} tokens at "
+                f"page_size {ps}")
+        added = 0
+        for q in range(1, n // ps + 1):
+            key = prefix[:q * ps].tobytes()
+            if key in self._entries:
+                continue
+            self.pool.incref(pages[:q])
+            self._entries[key] = _PrefixEntry(list(pages[:q]), q * ps)
             added += 1
         return added
 

@@ -84,11 +84,37 @@ class DecodePolicy:
     #: stream k+1 tokens per step — docs/SERVING.md "Speculative
     #: decode")
     speculate_k: int = 4
+    #: max prompts coalesced into ONE batched prefill per admission
+    #: round (``DecodeSession.admit_batch``); 1 = the pre-batching
+    #: serial path, one prefill program call per prompt
+    prefill_batch: int = 8
+    #: how long the OLDEST pending prompt may wait for company before
+    #: its batch launches regardless of occupancy — DynamicBatcher's
+    #: deadline-from-oldest, applied to admission (docs/SERVING.md
+    #: "Batched prefill")
+    prefill_delay_ms: float = 2.0
+
+
+class MigratedStream:
+    """Returned (never raised) by generate/generate_adopted when the
+    replica DRAINED mid-stream (scale-down page re-migration,
+    docs/SERVING.md): ``tokens`` are the already-emitted tokens MINUS
+    the pending one, which travels as the manifest's ``first_token`` —
+    the router stitches ``tokens + survivor_output`` for a result
+    byte-identical to an undrained run."""
+
+    __slots__ = ("tokens", "manifest", "k", "v")
+
+    def __init__(self, tokens: list[int], manifest: dict, k, v):
+        self.tokens = tokens
+        self.manifest = manifest
+        self.k = k
+        self.v = v
 
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "out", "done", "error", "t0",
-                 "t_last", "cancelled", "adopted")
+                 "t_last", "cancelled", "adopted", "migrated")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  adopted: tuple | None = None):
@@ -98,6 +124,9 @@ class _GenRequest:
         #: when this stream was prefilled elsewhere — admission adopts
         #: the pages instead of running a local prefill
         self.adopted = adopted
+        #: set by the drain path: this stream left as pages, the
+        #: parked caller returns the payload instead of tokens
+        self.migrated: MigratedStream | None = None
         self.out: list[int] = []
         self.done = threading.Event()
         self.error: BaseException | None = None
@@ -165,9 +194,27 @@ class ContinuousBatcher:
         #: prefill arrived as wire frames / typed-refused manifests
         self.n_adopted = 0
         self.n_adopt_refused = 0
+        #: batched prefill accounting: admission rounds that ran ONE
+        #: program call over >= 1 prompts, the largest such batch, and
+        #: prompt-token/wall-second totals (the bench's aggregate
+        #: prefill-throughput axis)
+        self.n_prefill_batches = 0
+        self.max_prefill_batch = 0
+        self.prefill_tokens = 0
+        self.prefill_s = 0.0
+        #: scale-down page re-migration: live streams exported as
+        #: MigratedStream payloads by drain_migrate()
+        self.n_migrated_out = 0
+        #: set by drain_migrate(); terminal — admission refuses, the
+        #: scheduler exports live streams at the next step boundary
+        self._draining = False
+        #: next coalescing deadline while admission holds a partial
+        #: batch for company (read by _loop for its wait bound)
+        self._admit_deadline = 0.0
         #: last-seen cow_copies across both sessions (delta -> monitor)
         self._cow_seen = 0
         self._intertoken_ms: deque[float] = deque(maxlen=4096)  # guarded_by: self._lock
+        self._ttft_ms: deque[float] = deque(maxlen=4096)  # guarded_by: self._lock
 
     # -- lifecycle ------------------------------------------------------
 
@@ -193,11 +240,13 @@ class ContinuousBatcher:
             return not self._dead and not self._stop.is_set()
 
     def reset_intertoken(self) -> None:
-        """Drop the inter-token latency ring (bench seam: a warm pass
-        compiles programs, and those multi-second gaps would otherwise
-        sit in the measured pass's p99)."""
+        """Drop the inter-token AND time-to-first-token latency rings
+        (bench seam: a warm pass compiles programs, and those
+        multi-second gaps would otherwise sit in the measured pass's
+        p99)."""
         with self._lock:
             self._intertoken_ms.clear()
+            self._ttft_ms.clear()
 
     def stats(self) -> dict:
         from theanompi_tpu.utils.token_accounting import (
@@ -208,8 +257,14 @@ class ContinuousBatcher:
             pending = len(self._pending)
             lat = (np.sort(np.asarray(self._intertoken_ms, np.float64))
                    if self._intertoken_ms else np.zeros((0,)))
-        pick = (lambda q: float(lat[min(len(lat) - 1, int(q * len(lat)))])
-                if len(lat) else None)
+            ttft = (np.sort(np.asarray(self._ttft_ms, np.float64))
+                    if self._ttft_ms else np.zeros((0,)))
+
+        def _pcts(a):
+            def pk(q):
+                return (float(a[min(len(a) - 1, int(q * len(a)))])
+                        if len(a) else None)
+            return {"p50": pk(0.50), "p99": pk(0.99), "count": len(a)}
         pc = self.session.prefix_cache
         # one-read snapshot: disable_speculation() nulls _draft on the
         # scheduler thread while stats() runs on an RPC handler thread
@@ -230,8 +285,14 @@ class ContinuousBatcher:
             "active": len(self._active),
             "pending": pending,
             "free_pages": self.session.pool.free_pages,
-            "intertoken_ms": {"p50": pick(0.50), "p99": pick(0.99),
-                              "count": len(lat)},
+            "intertoken_ms": _pcts(lat),
+            "ttft_ms": _pcts(ttft),
+            "prefill_batches": self.n_prefill_batches,
+            "max_prefill_batch": self.max_prefill_batch,
+            "prefill_tokens": self.prefill_tokens,
+            "prefill_s": self.prefill_s,
+            "drain_migrated": self.n_migrated_out,
+            "draining": self._draining,
             "compiles": dict(self.session.compiles),
             "draft_compiles": (dict(draft.compiles)
                                if draft is not None else None),
@@ -253,11 +314,13 @@ class ContinuousBatcher:
 
     # -- client side ----------------------------------------------------
 
-    def generate(self, prompt, max_new: int | None = None) -> list[int]:
+    def generate(self, prompt, max_new: int | None = None):
         """Greedy-decode up to ``max_new`` tokens after ``prompt``;
-        blocks until the sequence finishes.  Raises
-        :class:`Overloaded` on admission rejection or re-raises the
-        step error that consumed this request."""
+        blocks until the sequence finishes and returns the token list.
+        Raises :class:`Overloaded` on admission rejection or re-raises
+        the step error that consumed this request.  If the replica
+        drained mid-stream (scale-down), returns a
+        :class:`MigratedStream` instead of tokens."""
         if trace.enabled():
             # under tracing, a GENERATE handled via rpc_handle (the
             # serving plane) gets a decode-side child span here — the
@@ -268,7 +331,7 @@ class ContinuousBatcher:
                 return self._generate(prompt, max_new)
         return self._generate(prompt, max_new)
 
-    def _generate(self, prompt, max_new: int | None = None) -> list[int]:
+    def _generate(self, prompt, max_new: int | None = None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         max_new = int(max_new if max_new is not None
                       else self.policy.max_new_cap)
@@ -286,12 +349,13 @@ class ContinuousBatcher:
                 "(positional table)")
         req = _GenRequest(prompt, max_new)
         with self._cond:
-            if self._dead or self._stop.is_set():
+            if self._dead or self._draining or self._stop.is_set():
                 self.n_overloaded += 1
                 monitor.inc("decode/overloaded_total",
                             replica=self.replica)
                 raise Overloaded(
-                    f"decode replica {self.replica} is not serving")
+                    f"decode replica {self.replica} is not serving"
+                    + (" (draining)" if self._draining else ""))
             if len(self._pending) >= self.policy.max_pending:
                 self.n_overloaded += 1
                 monitor.inc("decode/overloaded_total",
@@ -318,10 +382,14 @@ class ContinuousBatcher:
                 f"{self.replica}")
         if req.error is not None:
             raise req.error
+        if req.migrated is not None:
+            # the replica drained mid-stream: hand the partial output
+            # + exported pages up for the router to re-dispatch
+            return req.migrated
         return req.out
 
     def generate_adopted(self, manifest: dict, k, v,
-                         max_new: int | None = None) -> list[int]:
+                         max_new: int | None = None):
         """Adopt a migrated prefill (decode/migrate.py) and greedy-
         decode up to ``max_new`` further tokens.  The manifest's
         ``first_token`` (the sender's prefill argmax) is emitted as
@@ -337,7 +405,7 @@ class ContinuousBatcher:
         return self._generate_adopted(manifest, k, v, max_new)
 
     def _generate_adopted(self, manifest, k, v,
-                          max_new: int | None = None) -> list[int]:
+                          max_new: int | None = None):
         faults.fire("page_migrate", side="adopt", replica=self.replica)
         # geometry refusal BEFORE enqueue: a stream that can never be
         # adopted must not occupy a pending slot (O(1), no data copy)
@@ -362,12 +430,13 @@ class ContinuousBatcher:
         prompt = np.asarray(manifest["prompt"], np.int32).reshape(-1)
         req = _GenRequest(prompt, max_new, adopted=(manifest, k, v))
         with self._cond:
-            if self._dead or self._stop.is_set():
+            if self._dead or self._draining or self._stop.is_set():
                 self.n_overloaded += 1
                 monitor.inc("decode/overloaded_total",
                             replica=self.replica)
                 raise Overloaded(
-                    f"decode replica {self.replica} is not serving")
+                    f"decode replica {self.replica} is not serving"
+                    + (" (draining)" if self._draining else ""))
             if len(self._pending) >= self.policy.max_pending:
                 self.n_overloaded += 1
                 monitor.inc("decode/overloaded_total",
@@ -392,20 +461,38 @@ class ContinuousBatcher:
                 f"{self.replica}")
         if req.error is not None:
             raise req.error
+        if req.migrated is not None:
+            # the replica drained mid-stream: hand the partial output
+            # + exported pages up for the router to re-dispatch
+            return req.migrated
         return req.out
 
     # -- scheduler thread ----------------------------------------------
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            self._admit()
+            if self._draining:
+                self._migrate_out()
+            else:
+                self._admit()
             if not self._active:
                 with self._cond:
-                    if not self._pending and not self._stop.is_set():
+                    if self._stop.is_set():
+                        continue
+                    if not self._pending:
                         self._cond.wait(0.25)
                         monitor.set_gauge("serving/replica_heartbeat",
                                           time.time(),
                                           replica=self.replica)
+                    else:
+                        # pending held back by the coalescing deadline
+                        # — sleep only until it expires (an arrival
+                        # notifies and may fill the batch early); the
+                        # floor guards the can't-admit-yet edge
+                        remaining = (self._admit_deadline
+                                     - time.monotonic())
+                        self._cond.wait(min(0.25, max(remaining,
+                                                      0.002)))
                 continue
             self._step()
         self._drain()
@@ -424,69 +511,240 @@ class ContinuousBatcher:
 
     def _admit(self) -> None:
         """Admit pending prompts into free slots — every iteration, so
-        the oldest waiter's deadline is one decode step away.  With a
-        draft session the prompt is admitted into BOTH caches (same
-        geometry, so a target admit implies draft capacity)."""
-        while (len(self._active) < self.session.cfg.max_seqs
-                and self.session.can_admit()
-                and (self._draft is None or self._draft.can_admit())
-                and not self._stop.is_set()):
-            req = self._take_pending()
-            if req is None:
-                return
-            if req.cancelled:
-                continue
-            if req.adopted is not None:
-                if not self._admit_adopted(req):
-                    return
-                continue
-            t0 = time.monotonic()
-            h0, m0, e0 = self._prefix_metrics()
-            try:
-                seq, logits = self.session.admit(req.prompt)
-            except Exception as e:
-                if isinstance(e, ValueError):
-                    # a bad request must not kill the replica
-                    self._fail_requests([req], e)
+        the oldest waiter's deadline is one decode step away.  With
+        ``prefill_batch > 1`` an admission round GATHERS up to that
+        many plain prompts and runs them as ONE
+        :meth:`~theanompi_tpu.decode.session.DecodeSession.admit_batch`
+        program call (adopted streams still admit singly — their pages
+        scatter, there is no prefill to batch).  With a draft session
+        the prompts are admitted into BOTH caches (same geometry, so a
+        target admit implies draft capacity)."""
+        pb = max(1, int(self.policy.prefill_batch))
+        while not self._stop.is_set():
+            if pb > 1 and self._hold_for_coalescing(pb):
+                break
+            batch: list[_GenRequest] = []
+            adopted_req: _GenRequest | None = None
+            while (len(self._active) + len(batch)
+                       < self.session.cfg.max_seqs
+                   and len(batch) < pb
+                   and self.session.can_admit(len(batch) + 1)
+                   and (self._draft is None
+                        or self._draft.can_admit(len(batch) + 1))
+                   and not self._stop.is_set()):
+                req = self._take_pending()
+                if req is None:
+                    break
+                if req.cancelled:
                     continue
-                self._abort_inflight(e, extra=[req])
+                if req.adopted is not None:
+                    # adopted streams admit singly: flush the gathered
+                    # batch first so arrival order is preserved
+                    adopted_req = req
+                    break
+                if self._shares_page_prefix(req, batch):
+                    # a same-round row cannot hit a prefix an earlier
+                    # row is about to register (inserts land after the
+                    # program runs): defer ONE round so it admits as a
+                    # cache hit sharing pages instead of refilling them
+                    with self._cond:
+                        self._pending.appendleft(req)
+                    break
+                batch.append(req)
+            if batch and not self._admit_plain(batch):
                 return
-            dseq = None
-            if self._draft is not None:
-                try:
-                    dseq, _ = self._draft.admit(req.prompt)
-                except Exception as e:
-                    self.session.release(seq)
-                    if isinstance(e, ValueError):
-                        self._fail_requests([req], e)
-                        continue
-                    self._abort_inflight(e, extra=[req])
+            if adopted_req is not None:
+                if not self._admit_adopted(adopted_req):
                     return
-            h1, m1, e1 = self._prefix_metrics()
-            if h1 > h0:
-                monitor.inc("decode/prefix_cache_hits_total",
-                            h1 - h0, replica=self.replica)
-            if m1 > m0:
-                monitor.inc("decode/prefix_cache_misses_total",
-                            m1 - m0, replica=self.replica)
-            if e1 > e0:
-                monitor.inc("decode/prefix_cache_evictions_total",
-                            e1 - e0, replica=self.replica)
-            monitor.observe("decode/prefill_ms",
-                            (time.monotonic() - t0) * 1e3,
-                            replica=self.replica)
-            self.n_admitted += 1
-            monitor.inc("decode/admitted_total", replica=self.replica)
-            self._active.append((req, seq, dseq))
-            self.max_concurrent = max(self.max_concurrent,
-                                      len(self._active))
-            self._emit_token(req, int(np.argmax(logits)))
-            self._evict_finished()
+                continue
+            if not batch:
+                break
         monitor.set_gauge("decode/cache_occupancy",
                           self.session.pool.used_fraction,
                           replica=self.replica)
         monitor.set_gauge("decode/active_seqs", len(self._active),
                           replica=self.replica)
+
+    def _shares_page_prefix(self, req: _GenRequest, batch) -> bool:
+        """True when ``req`` shares a >= 1-page aligned prompt prefix
+        with a row already gathered this round — the page-sharing
+        deferral above (no effect with the prefix cache off)."""
+        if self.session.prefix_cache is None or not batch:
+            return False
+        ps = int(self.session.cfg.page_size)
+        for r in batch:
+            a, b = r.prompt, req.prompt
+            n = min(int(a.shape[0]), int(b.shape[0]))
+            if n < ps:
+                continue
+            eq = a[:n] == b[:n]
+            m = n if eq.all() else int(np.argmin(eq))
+            if m >= ps:
+                return True
+        return False
+
+    def _hold_for_coalescing(self, pb: int) -> bool:
+        """DynamicBatcher's deadline-from-oldest applied to admission:
+        while the OLDEST pending prompt is younger than
+        ``prefill_delay_ms`` and more batchable room remains, hold off
+        so a burst coalesces into one prefill program call instead of
+        several small ones.  Never holds an adopted stream (no prefill
+        to batch), a full batch, or past the deadline — the delay
+        bounds added time-to-first-token exactly."""
+        delay_s = float(self.policy.prefill_delay_ms) / 1e3
+        if delay_s <= 0:
+            return False
+        with self._lock:
+            n = len(self._pending)
+            if n == 0 or self._pending[0].adopted is not None:
+                return False
+            oldest_t0 = self._pending[0].t0
+        room = min(pb,
+                   self.session.cfg.max_seqs - len(self._active))
+        if n >= room:
+            return False
+        deadline = oldest_t0 + delay_s
+        if time.monotonic() >= deadline:
+            return False
+        self._admit_deadline = deadline
+        return True
+
+    def _admit_plain(self, batch: list[_GenRequest]) -> bool:
+        """One admission round: N prompts -> ONE batched prefill
+        program call (``prefill_batch == 1`` keeps the pre-batching
+        serial ``admit`` path, byte-for-byte — the bench's comparison
+        leg).  Returns False only when the poisoned-device path ran
+        (``_abort_inflight``), mirroring ``_admit_adopted``."""
+        serial = max(1, int(self.policy.prefill_batch)) == 1
+        t0 = time.monotonic()
+        h0, m0, e0 = self._prefix_metrics()
+        try:
+            if serial:
+                admitted = [self.session.admit(batch[0].prompt)]
+            else:
+                admitted = self.session.admit_batch(
+                    [r.prompt for r in batch])
+        except Exception as e:
+            if isinstance(e, ValueError):
+                # a bad request must not kill the replica (lengths
+                # were validated at submit, so this is defensive)
+                self._fail_requests(batch, e)
+                return True
+            self._abort_inflight(e, extra=batch)
+            return False
+        dseqs: list = [None] * len(batch)
+        if self._draft is not None:
+            try:
+                if serial:
+                    dseq, _ = self._draft.admit(batch[0].prompt)
+                    dseqs = [dseq]
+                else:
+                    dseqs = [s for s, _ in self._draft.admit_batch(
+                        [r.prompt for r in batch])]
+            except Exception as e:
+                for seq, _ in admitted:
+                    self.session.release(seq)
+                if isinstance(e, ValueError):
+                    self._fail_requests(batch, e)
+                    return True
+                self._abort_inflight(e, extra=batch)
+                return False
+        h1, m1, e1 = self._prefix_metrics()
+        if h1 > h0:
+            monitor.inc("decode/prefix_cache_hits_total",
+                        h1 - h0, replica=self.replica)
+        if m1 > m0:
+            monitor.inc("decode/prefix_cache_misses_total",
+                        m1 - m0, replica=self.replica)
+        if e1 > e0:
+            monitor.inc("decode/prefix_cache_evictions_total",
+                        e1 - e0, replica=self.replica)
+        dt = time.monotonic() - t0
+        monitor.observe("decode/prefill_ms", dt * 1e3,
+                        replica=self.replica)
+        monitor.observe("decode/prefill_batch_occupancy",
+                        float(len(batch)), replica=self.replica)
+        self.n_prefill_batches += 1
+        self.max_prefill_batch = max(self.max_prefill_batch,
+                                     len(batch))
+        self.prefill_tokens += sum(int(r.prompt.shape[0])
+                                   for r in batch)
+        self.prefill_s += dt
+        self.n_admitted += len(batch)
+        monitor.inc("decode/admitted_total", float(len(batch)),
+                    replica=self.replica)
+        for req, (seq, logits), dseq in zip(batch, admitted, dseqs):
+            self._active.append((req, seq, dseq))
+            self._emit_token(req, int(np.argmax(logits)))
+        self.max_concurrent = max(self.max_concurrent,
+                                  len(self._active))
+        self._evict_finished()
+        return True
+
+    # -- scale-down page re-migration ----------------------------------
+
+    def drain_migrate(self) -> None:
+        """Scale-down hand-off (any thread): admission starts refusing
+        with Overloaded, pending requests fail with it (the router's
+        existing failover re-dispatches them), and at the next step
+        boundary the scheduler exports every LIVE stream's pages + a
+        resume manifest — the parked ``generate`` calls return
+        :class:`MigratedStream` payloads for the router to re-dispatch
+        onto a survivor, byte-identical.  Terminal: a draining replica
+        never resumes admission."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def _migrate_out(self) -> None:
+        """Drain leg (scheduler thread, step boundary): every live
+        stream leaves as pages + a resume manifest — prompt plus the
+        tokens emitted so far, with the PENDING token (emitted to the
+        caller but not yet decoded) travelling as the manifest's
+        ``first_token``.  The survivor re-emits exactly that token
+        first, so the stitched ``tokens + survivor_output`` is
+        byte-identical to finishing here."""
+        from theanompi_tpu.decode.migrate import page_manifest
+
+        active, self._active = self._active, []
+        for req, seq, dseq in active:
+            if self._finished(req):
+                self.session.release(seq)
+                if dseq is not None and self._draft is not None:
+                    self._draft.release(dseq)
+                self.n_evicted += 1
+                monitor.inc("decode/evictions_total",
+                            replica=self.replica)
+                req.done.set()
+                continue
+            try:
+                k, v = self.session.export_pages(seq)
+                # invariant: seq.length == len(prompt) + len(out) - 1
+                # for a live stream, so the resume prompt is exactly
+                # the attended positions and out[-1] is the pending
+                # token the survivor will decode first
+                resume = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.out[:-1], np.int32)])
+                manifest = page_manifest(
+                    self.session.cfg, resume, seq.length,
+                    int(req.out[-1]), version=self.session.version)
+                req.migrated = MigratedStream(
+                    [int(t) for t in req.out[:-1]], manifest, k, v)
+                self.n_migrated_out += 1
+                monitor.inc("decode/drain_migrated_total",
+                            replica=self.replica)
+            except Exception as e:
+                req.error = e
+            self.session.release(seq)
+            if dseq is not None and self._draft is not None:
+                self._draft.release(dseq)
+            req.done.set()
+        monitor.set_gauge("decode/active_seqs", 0,
+                          replica=self.replica)
+        self._fail_pending(Overloaded(
+            f"decode replica {self.replica} is draining "
+            "(scale-down)"))
 
     def _admit_adopted(self, req: _GenRequest) -> bool:
         """Admission for a migrated stream (decode/migrate.py): the
@@ -665,7 +923,14 @@ class ContinuousBatcher:
             # the first token is prefill's output: its latency is
             # queue wait + prefill (decode/prefill_ms covers it), not
             # an inter-token gap — recording it would let admission
-            # queueing contaminate the SLO histogram under overload
+            # queueing contaminate the SLO histogram under overload.
+            # It IS time-to-first-token, the axis batched prefill
+            # trades coalescing delay against — tracked separately.
+            ttft_ms = (now - req.t0) * 1e3
+            with self._lock:
+                self._ttft_ms.append(ttft_ms)
+            monitor.observe("decode/ttft_ms", ttft_ms,
+                            replica=self.replica)
             req.t_last = now
             return
         dt_ms = (now - req.t_last) * 1e3
@@ -771,7 +1036,8 @@ class DecodeReplica:
                  pages_per_seq: int = 8, max_seqs: int = 8,
                  prefill_buckets: tuple[int, ...] | None = None,
                  donate: bool = True, draft_export_dir: str | None = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 fleet_cache: str | None = None):
         from theanompi_tpu.decode.session import DecodeSession
         from theanompi_tpu.serving.export import (
             IncompatibleExport,
@@ -789,6 +1055,12 @@ class DecodeReplica:
             page_size=page_size, pages_per_seq=pages_per_seq,
             max_seqs=max_seqs, prefill_buckets=prefill_buckets,
             donate=donate, prefix_cache=prefix_cache)
+        if fleet_cache:
+            # fleet-wide prefix cache (decode/fleetcache.py): local
+            # misses consult the prefill-fleet authority, cold
+            # prefills register their page-aligned prefixes
+            from theanompi_tpu.decode.fleetcache import FleetCacheClient
+            self.session.fleet = FleetCacheClient(fleet_cache)
         #: speculative decoding: a second (small) decode-capable
         #: export proposes k tokens per round; same cache geometry so
         #: a target admit implies draft capacity
@@ -822,18 +1094,29 @@ class DecodeReplica:
         """Compile the smallest program of every family this replica
         can reach before the port binds."""
         self.session.warmup()
+        if int(self.batcher.policy.prefill_batch) > 1:
+            # occupancy varies run to run: every (n_seqs, token)
+            # bucket pair must be hot or the first odd-sized batch
+            # recompiles mid-serving
+            self.session.warmup_prefill_batch()
         if self.draft_session is not None:
             k = int(self.batcher.policy.speculate_k)
             self.session.warmup_spec(k, "target")
             self.draft_session.warmup()
+            if int(self.batcher.policy.prefill_batch) > 1:
+                self.draft_session.warmup_prefill_batch()
             self.draft_session.warmup_spec(k, "draft")
 
-    def generate(self, prompt, max_new: int | None = None) -> list[int]:
+    def generate(self, prompt, max_new: int | None = None):
         return self.batcher.generate(prompt, max_new)
 
     def generate_adopted(self, manifest: dict, k, v,
-                         max_new: int | None = None) -> list[int]:
+                         max_new: int | None = None):
         return self.batcher.generate_adopted(manifest, k, v, max_new)
+
+    def drain_migrate(self) -> None:
+        """Scale-down hand-off: see ContinuousBatcher.drain_migrate."""
+        self.batcher.drain_migrate()
 
     def swap(self, version: int, params, model_state=None) -> None:
         self.session.swap(version, params, model_state)

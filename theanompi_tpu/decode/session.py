@@ -38,6 +38,15 @@ the same bucket discipline so steady state stays recompile-free:
 * **extend** — prefix-cache hit prefill: the prompt SUFFIX (padded to
   a prefill bucket) attends the shared pages through the ring and
   scatters only its own K/V into freshly allocated pages.
+* **prefill_batch** — the admission plane's multi-sequence program:
+  S prompt chunks (cold prompts starting at 0, prefix-cache hits at
+  their shared-prefix length) run as ONE (decode-bucket ×
+  prefill-bucket) chunk step with per-row start offsets, per-row ring
+  masks, and count-masked page scatters — the ``write_tokens_all``
+  discipline lifted one axis up, so bucket-padding rows never write.
+  ``admit_batch`` replaces N serial ``admit`` calls with one program
+  call; ``warmup_prefill_batch`` compiles every (S, C) bucket pair up
+  front because occupancy varies run to run.
 
 Page sharing is host-side (refcounted ``PagePool`` + ``PrefixCache``,
 decode/kvcache.py) with copy-on-write: ``_cow_prepare`` runs before
@@ -173,9 +182,16 @@ class DecodeSession:
         #: the jitted bodies; the steady-state-zero-recompiles pin
         self.compiles = {"prefill": 0, "decode": 0, "verify": 0,
                          "propose": 0, "commit": 0, "extend": 0,
-                         "cow_copy": 0, "adopt": 0}
+                         "prefill_batch": 0, "cow_copy": 0, "adopt": 0}
+        #: fleet prefix-cache client (decode/fleetcache.py), attached
+        #: by the replica when --fleet-cache points at an authority;
+        #: None = local-only sharing
+        self.fleet = None
         self._prefill = jax.jit(
             self._prefill_fn, donate_argnums=(1, 2) if donate else ())
+        self._prefill_batch = jax.jit(
+            self._prefill_batch_fn,
+            donate_argnums=(1, 2) if donate else ())
         self._decode = jax.jit(
             self._decode_fn, donate_argnums=(1, 2) if donate else ())
         self._verify = jax.jit(
@@ -390,6 +406,48 @@ class DecodeSession:
                                            counts, jnp.stack(v_new))
         return k_pages, v_pages, logits[0, length - 1]
 
+    def _prefill_batch_fn(self, params, k_pages, v_pages, tokens,
+                          starts, counts, page_rows):
+        """Batched prefill/extend: S sequences' prompt chunks run as
+        ONE bucketed chunk step.  ``tokens``: (S, C) — each row the
+        tokens from its start offset (a cold prompt's whole prompt at
+        start 0, a prefix-cache hit's suffix at its shared-prefix
+        length), zero-padded; ``starts``/``counts``: (S,).  A cold row
+        sees an all-false ring mask (nothing stored yet) and the
+        in-chunk sliding-window causal mask alone — masked scores
+        exp-underflow to exact zeros, so each row's math is
+        byte-identical to its serial prefill/extend program.  Writes
+        are count-masked per row (``write_tokens_all``): bucket-
+        padding rows (count 0) and window-evicted positions of a
+        window-exceeding cold prompt never reach the pool."""
+        self.compiles["prefill_batch"] += 1  # trace-time counter
+        p = dequantize_tree(params)
+        c = tokens.shape[1]
+        starts = starts.astype(jnp.int32)
+        pos = jnp.minimum(
+            starts[:, None] + jnp.arange(c, dtype=jnp.int32),
+            self.max_len - 1)
+        x = embed_tokens(p, tokens, pos).astype(self.dtype)
+        ring_mask = kvcache.chunk_cache_mask(starts, c, self.window)
+        k_new, v_new = [], []
+        for layer in range(self.n_layers):
+            kc = kvcache.gather_layer(k_pages[layer], page_rows)
+            vc = kvcache.gather_layer(v_pages[layer], page_rows)
+            x, kn, vn = chunk_block(p[f"Block_{layer}"], x, kc, vc,
+                                    ring_mask, self.n_heads,
+                                    self.dtype, window=self.window)
+            k_new.append(kn)
+            v_new.append(vn)
+        logits = final_logits(p, x, self.dtype)            # (S, C, V)
+        counts = counts.astype(jnp.int32)
+        k_pages = kvcache.write_tokens_all(k_pages, page_rows, starts,
+                                           counts, jnp.stack(k_new))
+        v_pages = kvcache.write_tokens_all(v_pages, page_rows, starts,
+                                           counts, jnp.stack(v_new))
+        last = jnp.clip(counts - 1, 0, c - 1)[:, None, None]
+        return (k_pages, v_pages,
+                jnp.take_along_axis(logits, last, axis=1)[:, 0])
+
     def _copy_fn(self, k_pages, v_pages, src, dst):
         """Copy-on-write: duplicate pages ``src[i] -> dst[i]`` in both
         pools (fixed COPY_BUCKET pairs; padding writes to the dropped
@@ -413,13 +471,16 @@ class DecodeSession:
 
     # -- scheduler-facing host API (single scheduler thread) ------------
 
-    def can_admit(self) -> bool:
+    def can_admit(self, n: int = 1) -> bool:
+        """Whether ``n`` more sequences could allocate full page rows
+        (conservative for prefix-cache hits, which alias part of
+        theirs)."""
         free = self.pool.free_pages
         if self.prefix_cache is not None:
             # LRU eviction under allocation pressure frees cache-only
             # pages (_alloc_pages), so they count as admissible
             free += self.prefix_cache.evictable_pages()
-        return free >= self.cfg.pages_per_seq
+        return free >= int(n) * self.cfg.pages_per_seq
 
     def _alloc_pages(self, n: int) -> list[int] | None:
         """Allocate with eviction pressure: a full pool evicts prefix-
@@ -488,8 +549,7 @@ class DecodeSession:
                 f"prompt length {t} outside [1, {self.max_prompt}] "
                 "(largest prefill bucket)")
         _, params = self._live          # one-read snapshot
-        hit = (self.prefix_cache.lookup(prompt)
-               if self.prefix_cache is not None else None)
+        hit = self._lookup_prefix(prompt)
         if hit is not None:
             # adopt the shared pages BEFORE any allocation that could
             # evict the entry (and free them) out from under us
@@ -534,7 +594,134 @@ class DecodeSession:
                 raise
         if self.prefix_cache is not None:
             self.prefix_cache.insert(prompt, page_row)
+        if hit is None:
+            self._fleet_register(prompt, page_row)
         return _Seq(page_row, t), np.asarray(jax.device_get(logits))
+
+    def _lookup_prefix(self, prompt: np.ndarray):
+        """Local prefix-cache lookup, falling back to the fleet cache
+        authority when one is attached: a fleet hit adopts the shipped
+        pages locally (``adopt_prefix``) and re-resolves, so a remote
+        prefix becomes an ordinary local hit — all downstream sharing
+        (incref, COW, eviction) is the local discipline."""
+        if self.prefix_cache is None:
+            return None
+        hit = self.prefix_cache.lookup(prompt)
+        if hit is None and self.fleet is not None \
+                and self.fleet.fetch(self, prompt):
+            hit = self.prefix_cache.lookup(prompt)
+        return hit
+
+    def _fleet_register(self, prompt: np.ndarray, page_row) -> None:
+        """Offer a just-prefilled COLD prompt's longest page-aligned
+        proper prefix to the fleet cache authority (best effort: the
+        client counts transport errors, never raises — registration
+        must not fail an admission)."""
+        if self.fleet is None or self.prefix_cache is None:
+            return
+        t = int(prompt.shape[0])
+        if t > self.window:
+            return                      # prefilled through eviction
+        q = (t - 1) // self.cfg.page_size
+        if q < 1:
+            return
+        self.fleet.register(self, prompt[:q * self.cfg.page_size],
+                            [int(p) for p in page_row[:q]])
+
+    def admit_batch(self, prompts) -> list[tuple[_Seq, np.ndarray]]:
+        """Admit up to ``max_seqs`` prompts in ONE batched
+        prefill/extend program call — cold prompts and prefix-cache
+        hit suffixes batch together (both are "chunk forward from a
+        start offset").  Returns one ``(seq, last-token logits)`` pair
+        per prompt, in order; each row's output is byte-identical to
+        what a serial :meth:`admit` of the same prompt against the
+        same cache state would return.
+
+        Page accounting is per row with full unwind: any row's
+        allocation failure (or a failed program) drops every
+        already-taken reference, so a failed batch leaks nothing.  No
+        COW fence is needed — every write lands in pages allocated at
+        refcount 1 inside this call (shared hit pages are only read)."""
+        n = len(prompts)
+        if not 1 <= n <= self.cfg.max_seqs:
+            raise ValueError(
+                f"{n} prompts outside [1, {self.cfg.max_seqs}]")
+        prompts = [np.asarray(p, np.int32).reshape(-1)
+                   for p in prompts]
+        for p in prompts:
+            if not 1 <= p.shape[0] <= self.max_prompt:
+                raise ValueError(
+                    f"prompt length {p.shape[0]} outside "
+                    f"[1, {self.max_prompt}] (largest prefill bucket)")
+        if n == 1:
+            # a singleton rides the serial families (already warm) —
+            # the (n_seqs=1, token) batched variants would double the
+            # program inventory for an identical result
+            return [self.admit(prompts[0])]
+        _, params = self._live          # one-read snapshot
+        rows: list[tuple] = []  # (prompt, page_row, start, suffix, cold)
+        try:
+            for prompt in prompts:
+                t = prompt.shape[0]
+                hit = self._lookup_prefix(prompt)
+                if hit is not None:
+                    # adopt shared pages BEFORE any allocation that
+                    # could evict the entry (same order as admit)
+                    self.pool.incref(hit.pages)
+                    fresh = self._alloc_pages(
+                        self.cfg.pages_per_seq - len(hit.pages))
+                    if fresh is None:
+                        self.pool.decref(hit.pages)
+                        raise RuntimeError(
+                            "admit_batch() without free pages — the "
+                            "scheduler must check can_admit(n) first")
+                    page_row = np.asarray(list(hit.pages) + fresh,
+                                          np.int32)
+                    rows.append((prompt, page_row, hit.n_tokens,
+                                 t - hit.n_tokens, False))
+                else:
+                    got = self._alloc_pages(self.cfg.pages_per_seq)
+                    if got is None:
+                        raise RuntimeError(
+                            "admit_batch() without free pages — the "
+                            "scheduler must check can_admit(n) first")
+                    rows.append((prompt, np.asarray(got, np.int32),
+                                 0, t, True))
+        except Exception:
+            for _, page_row, *_ in rows:
+                self.pool.decref(page_row)
+            raise
+        sbucket = pick_bucket(n, self.decode_buckets)
+        cbucket = pick_bucket(max(r[3] for r in rows),
+                              self.prefill_buckets)
+        toks = np.zeros((sbucket, cbucket), np.int32)
+        starts = np.zeros((sbucket,), np.int32)
+        counts = np.zeros((sbucket,), np.int32)
+        prow = np.full((sbucket, self.cfg.pages_per_seq),
+                       self.cfg.n_pages, np.int32)
+        for i, (prompt, page_row, start, suffix, _) in enumerate(rows):
+            toks[i, :suffix] = prompt[start:]
+            starts[i] = start
+            counts[i] = suffix
+            prow[i] = page_row
+        try:
+            self._ck, self._cv, logits = self._prefill_batch(
+                params, self._ck, self._cv, jnp.asarray(toks),
+                jnp.asarray(starts), jnp.asarray(counts),
+                jnp.asarray(prow))
+        except Exception:
+            for _, page_row, *_ in rows:
+                self.pool.decref(page_row)
+            raise
+        logits = np.asarray(jax.device_get(logits))
+        out = []
+        for i, (prompt, page_row, _, _, cold) in enumerate(rows):
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(prompt, page_row)
+            if cold:
+                self._fleet_register(prompt, page_row)
+            out.append((_Seq(page_row, prompt.shape[0]), logits[i]))
+        return out
 
     def decode(self, seqs: list[_Seq],
                tokens: np.ndarray) -> np.ndarray:
@@ -678,9 +865,78 @@ class DecodeSession:
         d_head)`` per pool — the wire payload of a prefill→decode
         migration.  Read-only (shared/prefix-cache pages export the
         same bytes a local reader would see); call BEFORE release."""
-        rows = jnp.asarray(seq.page_row)
+        return self.export_page_ids(seq.page_row)
+
+    def export_page_ids(self, pages) -> tuple[np.ndarray, np.ndarray]:
+        """Arbitrary page ids' KV bytes as host arrays — ``(n_layers,
+        len(pages), page_size, n_heads, d_head)`` per pool.  The fleet
+        prefix-cache ship payload (the authority exports a leased
+        entry's pages; a registering replica exports its prompt's
+        prefix pages)."""
+        rows = jnp.asarray(np.asarray(pages, np.int32).reshape(-1))
         k, v = jax.device_get((self._ck[:, rows], self._cv[:, rows]))
         return np.asarray(k), np.asarray(v)
+
+    def export_pages_batch(self, seqs: list[_Seq]) -> list[tuple]:
+        """Every sequence's pages in ONE device transfer (the batched
+        prefill server's export leg) — equivalent to per-sequence
+        :meth:`export_pages` calls, minus S-1 device round-trips."""
+        rows = jnp.asarray(np.stack([s.page_row for s in seqs]))
+        k, v = jax.device_get((self._ck[:, rows], self._cv[:, rows]))
+        k, v = np.asarray(k), np.asarray(v)
+        return [(k[:, i], v[:, i]) for i in range(len(seqs))]
+
+    def adopt_prefix(self, prefix: np.ndarray, k: np.ndarray,
+                     v: np.ndarray) -> bool:
+        """Adopt fleet-shipped PREFIX pages — ``q`` already-filled
+        pages holding a page-aligned prompt prefix — as pure cache
+        content (no live sequence).  Arrays are ``(n_layers, q,
+        page_size, n_heads, d_head)`` per pool; they are zero-padded
+        to the fixed full-row shape so the ONE adopt program serves
+        both stream migration and prefix shipping (padding scatters to
+        the dropped page id — no new compile).  Returns False, with
+        nothing adopted, when sharing is off, the exact prefix is
+        already registered, or the pool stays too tight even under
+        eviction pressure; the caller treats False as a plain miss."""
+        if self.prefix_cache is None:
+            return False
+        prefix = np.asarray(prefix, np.int32).reshape(-1)
+        t = prefix.shape[0]
+        ps, pps = self.cfg.page_size, self.cfg.pages_per_seq
+        q = t // ps
+        if t < ps or t % ps or t > self.window:
+            raise ValueError(
+                f"adopt_prefix needs a page-aligned prefix of 1..{pps}"
+                f" pages, got {t} tokens")
+        expect = (self.n_layers, q, ps, self.n_heads, self.cfg.d_head)
+        if tuple(k.shape) != expect or tuple(v.shape) != expect:
+            raise ValueError(
+                f"prefix page arrays {tuple(k.shape)}/{tuple(v.shape)}"
+                f" do not match {expect}")
+        if self.prefix_cache.contains(prefix):
+            return False
+        got = self._alloc_pages(q)
+        if got is None:
+            return False
+        shape = (self.n_layers, pps, ps, self.n_heads, self.cfg.d_head)
+        kf = np.zeros(shape, self.dtype)
+        vf = np.zeros(shape, self.dtype)
+        kf[:, :q] = k
+        vf[:, :q] = v
+        page_row = np.full((pps,), self.cfg.n_pages, np.int32)
+        page_row[:q] = got
+        try:
+            self._ck, self._cv = self._adopt(
+                self._ck, self._cv, jnp.asarray(kf), jnp.asarray(vf),
+                jnp.asarray(page_row))
+        except Exception:
+            self.pool.decref(got)
+            raise
+        self.prefix_cache.insert_pages(prefix, got)
+        # the entries hold their own page refs now; dropping the
+        # allocation ref makes the pages cache-owned (LRU-evictable)
+        self.pool.decref(got)
+        return True
 
     def adopt_pages(self, manifest: dict, k: np.ndarray,
                     v: np.ndarray) -> _Seq:
@@ -764,6 +1020,29 @@ class DecodeSession:
                        self.cfg.d_head), self.dtype)
         self._ck, self._cv = self._adopt(self._ck, self._cv, z, z,
                                          jnp.asarray(drop_row))
+
+    def warmup_prefill_batch(self) -> None:
+        """Compile the batched prefill program for EVERY (decode
+        bucket × prefill bucket) pair up front.  Unlike the serial
+        families (whose shapes are per-request and compile-at-first-
+        use stays "once ever"), batch OCCUPANCY varies run to run with
+        arrival timing — a lazily compiled occupancy bucket would be a
+        mid-serving recompile, so the warmup cost buys back the
+        zero-steady-state-recompiles pin."""
+        _, params = self._live
+        for sb in self.decode_buckets:
+            if sb < 2:
+                # singleton admissions delegate to the serial
+                # families (admit_batch) — no (1, token) programs
+                continue
+            rows = np.full((sb, self.cfg.pages_per_seq),
+                           self.cfg.n_pages, np.int32)
+            z = jnp.zeros((sb,), jnp.int32)
+            for cb in self.prefill_buckets:
+                self._ck, self._cv, _ = self._prefill_batch(
+                    params, self._ck, self._cv,
+                    jnp.zeros((sb, cb), jnp.int32), z, z,
+                    jnp.asarray(rows))
 
     def warmup_spec(self, k: int, role: str) -> None:
         """Compile the speculative programs for the smallest decode

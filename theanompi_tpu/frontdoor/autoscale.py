@@ -383,6 +383,29 @@ class Autoscaler:
         # drain FIRST: the router stops routing new streams to it,
         # in-flight streams finish, and only a zero-stream backend dies
         self.router.remove_backend(role, addr)
+        if role == "decode":
+            # scale-down page re-migration (docs/SERVING.md): tell the
+            # replica to hand its LIVE streams back as pages — each
+            # parked generate returns a MigratedStream the router
+            # re-adopts on a survivor, so the drain barrier clears at
+            # the next step boundary instead of after a full stream.
+            # Best effort: on a pre-migration server the RPC fails and
+            # in-flight streams simply finish where they are.
+            try:
+                from theanompi_tpu.resilience.retry import RetryPolicy
+                from theanompi_tpu.serving.server import InferenceClient
+
+                c = InferenceClient(addr, retry=RetryPolicy(
+                    max_attempts=1, name="frontdoor-drain"))
+                try:
+                    c.drain_migrate()
+                finally:
+                    c.close()
+            except Exception as e:
+                print(f"[frontdoor] scale-down {role} {addr}: drain "
+                      f"RPC failed ({type(e).__name__}: {e}); waiting "
+                      "for in-flight streams to finish instead",
+                      flush=True)
         deadline = time.monotonic() + self.drain_timeout_s
         while self.router.backend_streams(role, addr) > 0:
             if time.monotonic() > deadline:

@@ -58,6 +58,9 @@ class DisaggregatedFleet:
                  prefill_max_pending: int = 8,
                  decode_max_pending: int = 32,
                  prefix_cache: bool = True,
+                 prefill_batch: int = 8,
+                 prefill_delay_ms: float = 2.0,
+                 fleet_cache: bool = True,
                  draft_export_dir: str | None = None,
                  speculate_k: int = 4, autoscale: bool = False,
                  scale_min: int = 1, scale_max: int = 4,
@@ -74,6 +77,16 @@ class DisaggregatedFleet:
         self.prefill_max_pending = int(prefill_max_pending)
         self.decode_max_pending = int(decode_max_pending)
         self.prefix_cache = bool(prefix_cache)
+        self.prefill_batch = int(prefill_batch)
+        self.prefill_delay_ms = float(prefill_delay_ms)
+        #: fleet-wide prefix cache (decode/fleetcache.py): the FIRST
+        #: prefill replica spawned becomes the authority; every later
+        #: replica — prefill peers and decode — points at it.  Best
+        #: effort by design: losing the authority degrades to local
+        #: misses, never failed admissions.  Needs the local prefix
+        #: cache (the authority stores entries in its own PrefixCache).
+        self.fleet_cache = bool(fleet_cache) and self.prefix_cache
+        self._authority_addr: str | None = None
         self.draft_export_dir = draft_export_dir
         self.speculate_k = int(speculate_k)
 
@@ -131,12 +144,22 @@ class DisaggregatedFleet:
                "--page-size", str(self.page_size),
                "--pages-per-seq", str(self.pages_per_seq),
                "--max-seqs", str(self.max_seqs),
-               "--max-pending", str(self.prefill_max_pending)]
+               "--max-pending", str(self.prefill_max_pending),
+               "--prefill-batch", str(self.prefill_batch),
+               "--prefill-delay-ms", str(self.prefill_delay_ms)]
         if self.prefill_buckets:
             cmd += ["--prefill-buckets",
                     ",".join(str(b) for b in self.prefill_buckets)]
         if not self.prefix_cache:
             cmd += ["--no-prefix-cache"]
+        if self.fleet_cache:
+            if self._authority_addr is None:
+                # first prefill replica spawned = the cache authority
+                # (serves cache_lookup/register/decref; needs no
+                # client of its own)
+                self._authority_addr = f"{self.host}:{port}"
+            else:
+                cmd += ["--fleet-cache", self._authority_addr]
         return cmd
 
     def _decode_argv(self, port: int) -> list[str]:
@@ -146,7 +169,12 @@ class DisaggregatedFleet:
                "--decode-page-size", str(self.page_size),
                "--decode-pages-per-seq", str(self.pages_per_seq),
                "--decode-max-seqs", str(self.max_seqs),
-               "--decode-max-pending", str(self.decode_max_pending)]
+               "--decode-max-pending", str(self.decode_max_pending),
+               "--decode-prefill-batch", str(self.prefill_batch),
+               "--decode-prefill-delay-ms",
+               str(self.prefill_delay_ms)]
+        if self.fleet_cache and self._authority_addr is not None:
+            cmd += ["--decode-fleet-cache", self._authority_addr]
         if self.prefill_buckets:
             cmd += ["--decode-prefill-buckets",
                     ",".join(str(b) for b in self.prefill_buckets)]
@@ -228,6 +256,18 @@ def main(argv=None) -> int:
     ap.add_argument("--prefill-max-pending", type=int, default=8)
     ap.add_argument("--decode-max-pending", type=int, default=32)
     ap.add_argument("--no-prefix-cache", action="store_true")
+    ap.add_argument("--prefill-batch", type=int, default=8,
+                    help="max prompts coalesced into ONE batched "
+                         "prefill program call, both roles "
+                         "(docs/SERVING.md 'Batched prefill'; 1 = "
+                         "serial prefill)")
+    ap.add_argument("--prefill-delay-ms", type=float, default=2.0,
+                    help="oldest-prompt coalescing deadline for "
+                         "batched prefill")
+    ap.add_argument("--no-fleet-cache", action="store_true",
+                    help="disable the fleet-wide prefix cache "
+                         "(prefill replica 0 as authority — "
+                         "docs/SERVING.md 'Fleet prefix cache')")
     ap.add_argument("--draft-export-dir", default=None, metavar="DIR",
                     help="speculative decoding on the decode fleet")
     ap.add_argument("--speculate-k", type=int, default=4)
@@ -261,6 +301,9 @@ def main(argv=None) -> int:
         prefill_max_pending=args.prefill_max_pending,
         decode_max_pending=args.decode_max_pending,
         prefix_cache=not args.no_prefix_cache,
+        prefill_batch=args.prefill_batch,
+        prefill_delay_ms=args.prefill_delay_ms,
+        fleet_cache=not args.no_fleet_cache,
         draft_export_dir=args.draft_export_dir,
         speculate_k=args.speculate_k, autoscale=args.autoscale,
         scale_min=args.scale_min, scale_max=args.scale_max,

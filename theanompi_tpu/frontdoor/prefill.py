@@ -21,11 +21,28 @@ prefills the RPC is refused with the typed
 :class:`~theanompi_tpu.serving.batcher.Overloaded` in O(1) — the
 router treats it as load-shedding and tries the next replica, never a
 destructive retry.
+
+Concurrent prefills COALESCE: handler threads enqueue their prompt and
+elect a leader (whoever lands the session lock first), and the leader
+drains up to ``prefill_batch`` queued prompts — waiting out a
+``DynamicBatcher``-style deadline measured from the OLDEST queued
+request — into ONE ``admit_batch`` program call plus one batched page
+export.  Followers park on their job's event; a prompt-heavy burst
+costs one device dispatch instead of N
+(docs/SERVING.md "Batched prefill").
+
+The fleet-wide prefix cache (``decode/fleetcache.py``) also lives
+here: ONE prefill replica serves the ``cache_lookup`` /
+``cache_register`` / ``cache_decref`` ops as the fleet's cache
+AUTHORITY, with a lease table whose page references make remote LRU
+eviction safe; every other replica attaches a ``FleetCacheClient`` to
+its session via ``--fleet-cache``.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import os
 import threading
 import time
@@ -33,8 +50,8 @@ import time
 import numpy as np
 
 from theanompi_tpu import monitor
-from theanompi_tpu.analysis.lockgraph import make_lock
-from theanompi_tpu.decode import migrate
+from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
+from theanompi_tpu.decode import fleetcache, migrate
 from theanompi_tpu.decode.session import DecodeSession
 from theanompi_tpu.parallel import rpc, wire
 from theanompi_tpu.parallel.service import ServiceClient, ServiceError
@@ -46,6 +63,19 @@ from theanompi_tpu.serving.export import build_model_from_meta, load_export
 DEFAULT_PORT = 45950
 
 
+class _PrefillJob:
+    """One queued prompt awaiting the coalescing leader."""
+
+    __slots__ = ("prompt", "t0", "done", "result", "error")
+
+    def __init__(self, prompt: np.ndarray):
+        self.prompt = prompt
+        self.t0 = time.monotonic()
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+
 class PrefillServer:
     """One prefill replica: prompt in, (manifest, KV pages) out."""
 
@@ -53,7 +83,10 @@ class PrefillServer:
                  pages_per_seq: int = 8, max_seqs: int = 8,
                  prefill_buckets: tuple[int, ...] | None = None,
                  max_pending: int = 8, warmup: bool = True,
-                 model=None, prefix_cache: bool = True):
+                 model=None, prefix_cache: bool = True,
+                 prefill_batch: int = 8,
+                 prefill_delay_ms: float = 2.0,
+                 fleet_cache: str | None = None):
         self.export_dir = os.path.abspath(export_dir)
         loaded = load_export(self.export_dir)
         if not loaded.meta.get("decode"):
@@ -69,16 +102,36 @@ class PrefillServer:
             max_seqs=max_seqs, prefill_buckets=prefill_buckets,
             prefix_cache=prefix_cache)
         self.max_pending = int(max_pending)
+        #: coalescing cap (1 = the pre-batching serial program path)
+        self.prefill_batch = max(1, int(prefill_batch))
+        #: how long the OLDEST queued prompt waits for company before
+        #: the leader launches a partial batch
+        self.prefill_delay_ms = float(prefill_delay_ms)
         # the session's host-side state (pool, prefix cache, jit calls)
         # is built for a single scheduler thread; RPC handlers are a
         # pool, so one lock serializes the admit→export→release window
+        # (the coalescing LEADER of each batch holds it)
         self._lock = make_lock("PrefillServer._lock")
         self.n_prefills = 0        # guarded_by: self._lock
+        self.n_batches = 0         # guarded_by: self._lock
+        #: live fleet-cache leases: lease id -> increfed page ids
+        self._leases: dict[str, list[int]] = {}  # guarded_by: self._lock
+        self._lease_seq = 0        # guarded_by: self._lock
         self._gate = make_lock("PrefillServer._gate")
         self._inflight = 0         # guarded_by: self._gate
         self.n_shed = 0            # guarded_by: self._gate
+        #: prompts awaiting a coalescing leader (lock order: _lock
+        #: before _bq_cond — the leader gathers under the session lock)
+        self._bq: collections.deque[_PrefillJob] = collections.deque()
+        self._bq_cond = make_condition(name="PrefillServer._bq_cond")
+        if fleet_cache:
+            # this replica is a fleet-cache CLIENT: local misses fetch
+            # from (and cold prefills register with) the authority
+            self.session.fleet = fleetcache.FleetCacheClient(fleet_cache)
         if warmup:
             self.session.warmup()
+            if self.prefill_batch > 1:
+                self.session.warmup_prefill_batch()
 
     # -- request path --------------------------------------------------
 
@@ -86,7 +139,12 @@ class PrefillServer:
         """One prompt pass: returns the page manifest and the filled
         pages.  O(1) typed ``Overloaded`` past the admission bound; a
         bad prompt (too long, empty) raises ``ValueError`` — a
-        per-request refusal either way, the replica keeps serving."""
+        per-request refusal either way, the replica keeps serving.
+
+        Concurrent calls coalesce (leader/follower over the session
+        lock): up to ``prefill_batch`` queued prompts run as one
+        batched program + one batched export, each caller still
+        getting exactly its own ``(manifest, pages)``."""
         with self._gate:
             if self._inflight >= self.max_pending:
                 self.n_shed += 1
@@ -98,25 +156,160 @@ class PrefillServer:
         try:
             faults.fire("page_migrate", side="export")
             prompt = np.asarray(prompt, np.int32).reshape(-1)
-            t0 = time.perf_counter()
-            with self._lock:
-                seq, logits = self.session.admit(prompt)
-                first = int(np.argmax(logits))
-                k, v = self.session.export_pages(seq)
-                manifest = migrate.page_manifest(
-                    self.session.cfg, prompt, seq.length, first,
-                    version=self.session.version)
-                # pages are exported — this replica is done with the
-                # stream; only the prefix cache may keep them shared
-                self.session.release(seq)
-                self.n_prefills += 1
-            monitor.inc("frontdoor/prefills_total")
-            monitor.observe("frontdoor/prefill_ms",
-                            (time.perf_counter() - t0) * 1000.0)
-            return manifest, wire.RawArrays(k, v)
+            t = prompt.shape[0]
+            if not 1 <= t <= self.session.max_prompt:
+                # refuse BEFORE enqueue so one bad prompt can never
+                # fail the batch it would have ridden in
+                raise ValueError(
+                    f"prompt length {t} outside "
+                    f"[1, {self.session.max_prompt}] (largest prefill "
+                    "bucket)")
+            job = _PrefillJob(prompt)
+            with self._bq_cond:
+                self._bq.append(job)
+                self._bq_cond.notify_all()
+            # leader election: whoever lands the session lock first
+            # drains a batch (which may or may not include this job —
+            # loop until someone's batch carried it)
+            while not job.done.is_set():
+                with self._lock:
+                    if not job.done.is_set():
+                        self._run_batch_locked()
+            if job.error is not None:
+                raise job.error
+            return job.result
         finally:
             with self._gate:
                 self._inflight -= 1
+
+    def _run_batch_locked(self) -> None:  # requires_lock: self._lock
+        """Leader leg (session lock held): wait out the oldest queued
+        prompt's coalescing deadline, drain up to ``prefill_batch``
+        jobs, run ONE admit + export for all of them, and resolve each
+        job's event.  Pages always release — an exported batch leaves
+        no stream state behind, success or failure."""
+        cap = min(self.prefill_batch, self.session.cfg.max_seqs)
+        with self._bq_cond:
+            if not self._bq:
+                return
+            if cap > 1 and self.prefill_delay_ms > 0:
+                deadline = self._bq[0].t0 + self.prefill_delay_ms / 1e3
+                while len(self._bq) < cap:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._bq_cond.wait(remaining)
+            jobs = [self._bq.popleft()
+                    for _ in range(min(cap, len(self._bq)))]
+        if not jobs:
+            return
+        t0 = time.perf_counter()
+        try:
+            if cap == 1:
+                # serial program path, byte-for-byte the pre-batching
+                # behavior (the bench's serial comparison leg)
+                admitted = [self.session.admit(jobs[0].prompt)]
+            else:
+                admitted = self.session.admit_batch(
+                    [j.prompt for j in jobs])
+        except Exception as e:
+            for job in jobs:
+                job.error = e
+                job.done.set()
+            return
+        try:
+            exported = self.session.export_pages_batch(
+                [s for s, _ in admitted])
+            for job, (seq, logits), (k, v) in zip(jobs, admitted,
+                                                  exported):
+                first = int(np.argmax(logits))
+                manifest = migrate.page_manifest(
+                    self.session.cfg, job.prompt, seq.length, first,
+                    version=self.session.version)
+                job.result = (manifest, wire.RawArrays(k, v))
+            self.n_prefills += len(jobs)
+            self.n_batches += 1
+        except Exception as e:
+            for job in jobs:
+                job.error = e
+        finally:
+            # pages are exported (or the batch failed) — this replica
+            # is done with the streams; only the prefix cache may keep
+            # their pages shared
+            for seq, _ in admitted:
+                self.session.release(seq)
+            for job in jobs:
+                job.done.set()
+        monitor.inc("frontdoor/prefills_total", float(len(jobs)))
+        monitor.observe("frontdoor/prefill_batch_occupancy",
+                        float(len(jobs)))
+        monitor.observe("frontdoor/prefill_ms",
+                        (time.perf_counter() - t0) * 1000.0)
+
+    # -- fleet prefix-cache authority (decode/fleetcache.py) -----------
+
+    def cache_lookup(self, prompt):
+        """Authority op: longest page-aligned cached prefix of
+        ``prompt``.  A hit increfs the entry's pages under a fresh
+        lease and ships their bytes — the lease's reference is what
+        makes remote eviction safe: evicting the entry drops ITS
+        references, but a page cannot reach zero (and free) until
+        :meth:`cache_decref` drops the lease too."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            pc = self.session.prefix_cache
+            entry = pc.lookup(prompt) if pc is not None else None
+            if entry is None:
+                monitor.inc("frontdoor/fleet_cache_lookups_total",
+                            result="miss")
+                return None
+            self.session.pool.incref(entry.pages)
+            self._lease_seq += 1
+            lease = f"lease-{os.getpid()}-{self._lease_seq}"
+            self._leases[lease] = list(entry.pages)
+            k, v = self.session.export_page_ids(entry.pages)
+            manifest = fleetcache.prefix_manifest(
+                self.session.cfg, prompt[:entry.n_tokens],
+                version=self.session.version)
+            monitor.inc("frontdoor/fleet_cache_lookups_total",
+                        result="hit")
+            monitor.set_gauge("frontdoor/fleet_cache_leases",
+                              float(len(self._leases)))
+        return manifest, wire.RawArrays(k, v), lease
+
+    def cache_decref(self, lease_id) -> str:
+        """Authority op: release a lease's page reference.  Unknown
+        leases (foreign, double decref) raise the typed
+        :class:`~theanompi_tpu.decode.fleetcache.LeaseError` — a
+        per-call refusal that can never unbalance the refcounts."""
+        with self._lock:
+            pages = self._leases.pop(str(lease_id), None)
+            if pages is None:
+                raise fleetcache.LeaseError(
+                    f"unknown lease {lease_id!r} (foreign, or already "
+                    "released)")
+            self.session.pool.decref(pages)
+            monitor.set_gauge("frontdoor/fleet_cache_leases",
+                              float(len(self._leases)))
+        return "ok"
+
+    def cache_register(self, manifest, pages) -> dict:
+        """Authority op: adopt a peer's just-prefilled prefix pages as
+        cache content.  Geometry/shape mismatches raise the typed
+        ``IncompatiblePages`` refusal before the pool is touched."""
+        k, v = pages          # RawArrays decodes to a plain tuple
+        with self._lock:
+            if self.session.prefix_cache is None:
+                return {"added": False,
+                        "reason": "prefix cache disabled"}
+            reason = fleetcache.prefix_incompatibility(
+                manifest, k, v, self.session.cfg)
+            if reason is not None:
+                raise migrate.IncompatiblePages(reason)
+            added = self.session.adopt_prefix(
+                np.asarray(manifest["prefix"], np.int32), k, v)
+        monitor.inc("frontdoor/fleet_cache_registers_total")
+        return {"added": bool(added)}
 
     # -- introspection -------------------------------------------------
 
@@ -124,7 +317,8 @@ class PrefillServer:
         with self._gate:
             inflight, shed = self._inflight, self.n_shed
         with self._lock:
-            prefills = self.n_prefills
+            prefills, batches = self.n_prefills, self.n_batches
+            leases = len(self._leases)
             pc = self.session.prefix_cache
             hits = (None if pc is None
                     else {"hits": pc.hits, "misses": pc.misses,
@@ -133,6 +327,9 @@ class PrefillServer:
             "role": "prefill",
             "version": self.session.version,
             "prefills": prefills,
+            "prefill_batches": batches,
+            "prefill_batch": self.prefill_batch,
+            "fleet_cache_leases": leases,
             "inflight": inflight,
             "max_pending": self.max_pending,
             "overloaded": shed,
@@ -151,6 +348,15 @@ class PrefillServer:
         if op == "prefill":
             (prompt,) = args
             return self.prefill(prompt)
+        if op == "cache_lookup":
+            (prompt,) = args
+            return self.cache_lookup(prompt)
+        if op == "cache_register":
+            manifest, pages = args
+            return self.cache_register(manifest, pages)
+        if op == "cache_decref":
+            (lease_id,) = args
+            return self.cache_decref(lease_id)
         if op == "stats":
             return self.stats()
         if op == "ping":
@@ -221,6 +427,15 @@ def main(argv=None) -> int:
     ap.add_argument("--prefill-buckets", default=None, metavar="N,N,...")
     ap.add_argument("--max-pending", type=int, default=8)
     ap.add_argument("--no-prefix-cache", action="store_true")
+    ap.add_argument("--prefill-batch", type=int, default=8,
+                    help="max prompts coalesced into one batched "
+                         "prefill (1 = serial programs)")
+    ap.add_argument("--prefill-delay-ms", type=float, default=2.0,
+                    help="how long the oldest queued prompt waits "
+                         "for company before a partial batch runs")
+    ap.add_argument("--fleet-cache", default=None, metavar="HOST:PORT",
+                    help="fleet prefix-cache authority address (this "
+                         "replica becomes a fleet-cache client)")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args(argv)
     if args.platform:
@@ -239,7 +454,10 @@ def main(argv=None) -> int:
             args.export_dir, page_size=args.page_size,
             pages_per_seq=args.pages_per_seq, max_seqs=args.max_seqs,
             prefill_buckets=buckets, max_pending=args.max_pending,
-            prefix_cache=not args.no_prefix_cache)
+            prefix_cache=not args.no_prefix_cache,
+            prefill_batch=args.prefill_batch,
+            prefill_delay_ms=args.prefill_delay_ms,
+            fleet_cache=args.fleet_cache)
         s = server.session
         print(f"[frontdoor] PREFILL v{s.version} on "
               f"{args.host}:{args.port} (window={s.window}, "

@@ -176,6 +176,9 @@ class Router:
         self.n_streams = 0                        # guarded_by: self._lock
         self.n_shed = 0                           # guarded_by: self._lock
         self.n_failovers = 0                      # guarded_by: self._lock
+        #: streams re-adopted onto a survivor after a decode backend
+        #: drained mid-stream (scale-down page re-migration)
+        self.n_migrations = 0                     # guarded_by: self._lock
         for addr in prefill or []:
             self.add_backend("prefill", addr)
         for addr in decode or []:
@@ -350,7 +353,13 @@ class Router:
     def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
         """One full client stream across the two fleets; returns the
         generated token ids (first token included), byte-identical to
-        a single-role decode server's ``generate`` of the same prompt."""
+        a single-role decode server's ``generate`` of the same prompt.
+        A decode backend that DRAINS mid-stream (scale-down) hands the
+        stream back as pages + partial tokens; the router adopts them
+        onto a survivor and stitches the halves — still
+        byte-identical."""
+        from theanompi_tpu.decode.scheduler import MigratedStream
+
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
             if self._active >= self.max_streams:
@@ -365,27 +374,54 @@ class Router:
         try:
             with monitor.span("page_migrate", phase="prefill"):
                 manifest, k, v = self._prefill_leg(prompt)
-            for attempt in range(self.failover_attempts + 1):
+            total: list[int] = []
+            remaining = max_new
+            failovers = 0
+            migrations = 0
+            while True:
                 try:
-                    out = self._decode_leg(manifest, k, v, max_new)
-                    return np.asarray(out, np.int32)
+                    out = self._decode_leg(manifest, k, v, remaining)
                 except ConnectionError as e:
-                    if attempt >= self.failover_attempts:
+                    if failovers >= self.failover_attempts:
                         raise
-                    # the decode replica died mid-stream; no token of
-                    # this stream was delivered (the adopt RPC returns
-                    # whole streams), so re-prefilling the prompt and
+                    failovers += 1
+                    # the decode replica died mid-stream; none of THIS
+                    # leg's tokens were delivered (the adopt RPC
+                    # returns whole streams), so re-prefilling the
+                    # manifest's prompt — the original prompt, or the
+                    # resume prompt after a drain migration — and
                     # adopting onto a survivor reproduces the greedy
                     # stream byte-for-byte
                     with self._lock:
                         self.n_failovers += 1
                     monitor.inc("frontdoor/failovers_total")
                     print(f"[frontdoor] decode leg failover "
-                          f"({attempt + 1}/{self.failover_attempts}): "
+                          f"({failovers}/{self.failover_attempts}): "
                           f"{e}", flush=True)
+                    seed = np.asarray(manifest["prompt"], np.int32)
                     with monitor.span("page_migrate", phase="failover"):
-                        manifest, k, v = self._prefill_leg(prompt)
-            raise AssertionError("unreachable")  # loop returns or raises
+                        manifest, k, v = self._prefill_leg(seed)
+                    continue
+                if isinstance(out, MigratedStream):
+                    # the backend drained (scale-down): accumulate its
+                    # partial tokens, adopt the exported pages onto a
+                    # survivor — the resume manifest's first_token is
+                    # the pending token, so nothing is lost or doubled
+                    if migrations >= 8:
+                        raise Overloaded(
+                            "stream migrated 8 times without "
+                            "finishing (decode fleet is thrashing)")
+                    migrations += 1
+                    with self._lock:
+                        self.n_migrations += 1
+                    monitor.inc("frontdoor/drain_migrations_total")
+                    total.extend(int(t) for t in out.tokens)
+                    if remaining is not None:
+                        remaining -= len(out.tokens)
+                    manifest, k, v = out.manifest, out.k, out.v
+                    continue
+                return np.asarray(total + [int(t) for t in out],
+                                  np.int32)
         finally:
             with self._lock:
                 self._active -= 1
@@ -404,6 +440,7 @@ class Router:
                 "streams": self.n_streams,
                 "shed": self.n_shed,
                 "failovers": self.n_failovers,
+                "migrations": self.n_migrations,
             }
         out["backends"] = backends
         return out

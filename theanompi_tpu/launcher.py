@@ -253,6 +253,22 @@ def _build_parser(multihost: bool) -> argparse.ArgumentParser:
                         "prefix cache (copy-on-write KV page sharing "
                         "is on by default — docs/SERVING.md 'Prefix "
                         "cache')")
+    p.add_argument("--decode-prefill-batch", type=int, default=8,
+                   help="SERVE --decode: max prompts coalesced into "
+                        "ONE batched prefill program call per "
+                        "admission round (1 = serial prefill — "
+                        "docs/SERVING.md 'Batched prefill')")
+    p.add_argument("--decode-prefill-delay-ms", type=float,
+                   default=2.0,
+                   help="SERVE --decode: how long the oldest pending "
+                        "prompt may wait for batch company before its "
+                        "prefill launches anyway")
+    p.add_argument("--decode-fleet-cache", default=None,
+                   metavar="HOST:PORT",
+                   help="SERVE --decode: fleet-wide prefix-cache "
+                        "authority (a prefill server) consulted on "
+                        "local prefix-cache misses — docs/SERVING.md "
+                        "'Fleet prefix cache'")
     p.add_argument("--disaggregate", action="store_true",
                    help="SERVE --decode: split the deployment into a "
                         "prefill fleet + decode fleet behind the "
@@ -516,6 +532,8 @@ def _run_session(args, multihost: bool) -> int:
                 prefill_buckets=pb,
                 decode_max_pending=args.decode_max_pending,
                 prefix_cache=not args.decode_no_prefix_cache,
+                prefill_batch=args.decode_prefill_batch,
+                prefill_delay_ms=args.decode_prefill_delay_ms,
                 draft_export_dir=args.decode_draft_export_dir,
                 speculate_k=args.decode_speculate_k,
                 autoscale=args.autoscale, scale_max=args.scale_max,

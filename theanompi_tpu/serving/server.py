@@ -184,7 +184,8 @@ class InferenceServer:
             pol_kw = {k: opts.pop(k)
                       for k in ("max_pending", "max_new_cap",
                                 "submit_timeout_s", "eos_token",
-                                "speculate_k")
+                                "speculate_k", "prefill_batch",
+                                "prefill_delay_ms")
                       if k in opts}
             self.replicas = [
                 DecodeReplica(i, self.export_dir, self.model, loaded,
@@ -302,18 +303,22 @@ class InferenceServer:
         return self._route("submit", x)
 
     def generate(self, prompt: np.ndarray,
-                 max_new: int | None = None) -> np.ndarray:
+                 max_new: int | None = None):
         """Route one token-generation request to a live decode
-        replica; returns the generated token ids (int32)."""
+        replica; returns the generated token ids (int32) — or a
+        :class:`~theanompi_tpu.decode.scheduler.MigratedStream` when
+        the replica drained mid-stream (scale-down)."""
         if not self.decode:
             raise ValueError("this server runs eval mode; start it "
                              "with decode=True (tmlocal SERVE "
                              "--decode) for the generate op")
         out = self._route("generate", prompt, max_new)
+        if not isinstance(out, (list, np.ndarray)):
+            return out  # MigratedStream
         return np.asarray(out, np.int32)
 
     def generate_adopted(self, manifest: dict, k, v,
-                         max_new: int | None = None) -> np.ndarray:
+                         max_new: int | None = None):
         """Route one MIGRATED stream (decode/migrate.py: a prefill
         replica's pages + manifest) to a live decode replica, which
         adopts the pages and decodes from there.  A geometry mismatch
@@ -325,7 +330,21 @@ class InferenceServer:
                              "--decode) for the adopt op")
         out = self._route("generate_adopted", manifest,
                           np.asarray(k), np.asarray(v), max_new)
+        if not isinstance(out, (list, np.ndarray)):
+            return out  # MigratedStream
         return np.asarray(out, np.int32)
+
+    def drain_migrate(self) -> int:
+        """Scale-down hand-off: every decode replica stops admitting
+        (Overloaded) and exports its live streams as MigratedStream
+        payloads at the next step boundary (the autoscaler's decode
+        scale-down path — docs/SERVING.md).  Returns the replica
+        count told to drain."""
+        if not self.decode:
+            raise ValueError("drain_migrate is a decode-mode op")
+        for r in self.replicas:
+            r.drain_migrate()
+        return len(self.replicas)
 
     # -- hot reload ----------------------------------------------------
 
@@ -543,23 +562,38 @@ class InferenceServer:
             per = self.policy.max_queue + self.policy.max_batch
         return n * per + 8
 
+    @staticmethod
+    def _wire_tokens(out):
+        """Wire encoding for a generate/adopt result: a token array,
+        or a drained stream's pages as a tagged tuple (the token ids
+        can never collide with the tag — normal results are arrays)."""
+        if isinstance(out, np.ndarray):
+            return out
+        # MigratedStream: partial tokens + manifest + pages
+        return ("migrated", [int(t) for t in out.tokens], out.manifest,
+                wire.RawArrays(np.asarray(out.k), np.asarray(out.v)))
+
     def handle(self, op: str, *args):
         if op == "infer":
             (x,) = args
             return self.submit(np.asarray(x))
         if op == "generate":
             prompt, max_new = args
-            return self.generate(np.asarray(prompt, np.int32),
-                                 None if max_new is None
-                                 else int(max_new))
+            return self._wire_tokens(
+                self.generate(np.asarray(prompt, np.int32),
+                              None if max_new is None
+                              else int(max_new)))
         if op == "adopt":
             # pages arrive as one RawArrays frame pair (decoded to a
             # plain (k, v) tuple by the wire) + the page manifest
             manifest, pages, max_new = args
             k, v = pages
-            return self.generate_adopted(manifest, k, v,
-                                         None if max_new is None
-                                         else int(max_new))
+            return self._wire_tokens(
+                self.generate_adopted(manifest, k, v,
+                                      None if max_new is None
+                                      else int(max_new)))
+        if op == "drain":
+            return self.drain_migrate()
         if op == "stats":
             return self.stats()
         if op == "reload":
@@ -658,18 +692,34 @@ class InferenceClient(ServiceClient):
                 raise Overloaded(str(e)) from None
             raise
 
-    def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
+    @staticmethod
+    def _unwire_tokens(out):
+        """Inverse of ``InferenceServer._wire_tokens``: token ids, or
+        a drained stream's ``MigratedStream`` for the router to
+        re-dispatch (frontdoor/router.py stitches the halves)."""
+        if (isinstance(out, tuple) and len(out) == 4
+                and out[0] == "migrated"):
+            from theanompi_tpu.decode.scheduler import MigratedStream
+
+            _, tokens, manifest, pages = out
+            k, v = pages
+            return MigratedStream([int(t) for t in tokens],
+                                  manifest, k, v)
+        return np.asarray(out, np.int32)
+
+    def generate(self, prompt, max_new: int | None = None):
         """Greedy-decode up to ``max_new`` tokens after ``prompt`` on
-        a decode-mode server; returns the generated token ids (int32).
+        a decode-mode server; returns the generated token ids (int32),
+        or a ``MigratedStream`` when the serving replica drained
+        mid-stream (scale-down — the caller re-dispatches).
         At-least-once safe like ``infer``: generation is deterministic
         (greedy) given the export version, and a redelivered request
         only costs duplicate work, never duplicate side effects."""
         try:
-            return np.asarray(
+            return self._unwire_tokens(
                 self.call("generate",
                           np.asarray(prompt, np.int32),
-                          None if max_new is None else int(max_new)),
-                np.int32)
+                          None if max_new is None else int(max_new)))
         except ServiceError as e:
             if Overloaded.__name__ in str(e):
                 raise Overloaded(str(e)) from None
@@ -688,16 +738,20 @@ class InferenceClient(ServiceClient):
         admission rejections re-raise :class:`Overloaded` — the
         connection survives both."""
         try:
-            return np.asarray(
+            return self._unwire_tokens(
                 self.call("adopt", manifest, wire.RawArrays(k, v),
-                          None if max_new is None else int(max_new)),
-                np.int32)
+                          None if max_new is None else int(max_new)))
         except ServiceError as e:
             if Overloaded.__name__ in str(e):
                 raise Overloaded(str(e)) from None
             if IncompatiblePages.__name__ in str(e):
                 raise IncompatiblePages(str(e)) from None
             raise
+
+    def drain_migrate(self) -> int:
+        """Tell a decode server to drain: stop admitting, export live
+        streams as MigratedStream payloads (scale-down hand-off)."""
+        return int(self.call("drain"))
 
     def stats(self) -> dict:
         return self.call("stats")
@@ -735,7 +789,11 @@ def decode_opts_from_args(args) -> dict | None:
         "max_seqs": args.decode_max_seqs,
         "max_pending": args.decode_max_pending,
         "prefix_cache": not args.decode_no_prefix_cache,
+        "prefill_batch": args.decode_prefill_batch,
+        "prefill_delay_ms": args.decode_prefill_delay_ms,
     }
+    if args.decode_fleet_cache:
+        opts["fleet_cache"] = args.decode_fleet_cache
     if args.decode_prefill_buckets:
         opts["prefill_buckets"] = tuple(
             int(b) for b in args.decode_prefill_buckets.split(","))
@@ -840,6 +898,23 @@ def main(argv=None) -> int:
                     help="disable the cross-request prefix cache "
                          "(copy-on-write KV page sharing; on by "
                          "default — docs/SERVING.md 'Prefix cache')")
+    ap.add_argument("--decode-prefill-batch", type=int, default=8,
+                    help="max prompts coalesced into ONE batched "
+                         "prefill program call per admission round "
+                         "(1 = serial prefill, the pre-batching path "
+                         "— docs/SERVING.md 'Batched prefill')")
+    ap.add_argument("--decode-prefill-delay-ms", type=float,
+                    default=2.0,
+                    help="how long the oldest pending prompt may wait "
+                         "for batch company before its prefill "
+                         "launches regardless of occupancy")
+    ap.add_argument("--decode-fleet-cache", default=None,
+                    metavar="HOST:PORT",
+                    help="fleet-wide prefix cache authority (a "
+                         "prefill server's port): local prefix-cache "
+                         "misses consult it, cold prefills register "
+                         "their page-aligned prefixes — docs/"
+                         "SERVING.md 'Fleet prefix cache'")
     ap.add_argument("--platform", default=None,
                     help="jax platform (e.g. 'cpu')")
     ap.add_argument("--compilation-cache-dir", default=None, metavar="DIR",

@@ -357,7 +357,9 @@ def run_load(addr: str, sample: np.ndarray, mode: str, clients: int,
 
 
 def _start_decode_server(export_dir: str, args, draft_dir: str | None,
-                         prefix_cache: bool):
+                         prefix_cache: bool,
+                         prefill_batch: int | None = None,
+                         prefill_delay_ms: float | None = None):
     from theanompi_tpu.serving import InferenceServer, serve
 
     decode_opts = dict(
@@ -366,6 +368,10 @@ def _start_decode_server(export_dir: str, args, draft_dir: str | None,
         page_size=args.decode_page_size,
         pages_per_seq=args.decode_pages_per_seq,
         prefix_cache=prefix_cache)
+    if prefill_batch is not None:
+        decode_opts["prefill_batch"] = int(prefill_batch)
+    if prefill_delay_ms is not None:
+        decode_opts["prefill_delay_ms"] = float(prefill_delay_ms)
     if args.decode_prefill_buckets:
         decode_opts["prefill_buckets"] = tuple(
             int(b) for b in args.decode_prefill_buckets.split(","))
@@ -506,6 +512,131 @@ def trace_main(args, tmp_dir: str) -> dict:
         o = opt["tok_s_per_stream"]["mean"]
         out["per_stream_speedup"] = o / b if b else None
     return out
+
+
+def prefill_compare_main(args, tmp_dir: str) -> dict:
+    """``--prefill-compare``: the SAME concurrent prompt trace twice
+    on fresh in-process decode servers — serial admission
+    (``prefill_batch=1``, byte-for-byte the pre-batching path) vs
+    batched admission (``--decode-prefill-batch`` prompts per program
+    launch).  Headline: **aggregate prefill tok/s** (prompt tokens /
+    prefill program wall, the batcher's own counters) and **TTFT
+    p50/p99** from the per-stream time-to-first-token ring, measured
+    on a warm second pass.  Verifies both legs' outputs are
+    byte-identical and neither compiles anything in the measured pass
+    (committed: ``artifacts/BENCH_prefill_batch.json``)."""
+    from theanompi_tpu.serving import InferenceClient, load_export
+
+    export_dir = args.export_dir
+    if export_dir is None:
+        if not args.demo:
+            raise SystemExit(
+                "--prefill-compare needs --export-dir or --demo (it "
+                "starts its own in-process servers)")
+        export_dir = _demo_export(
+            tmp_dir, decode=True, d_model=args.demo_d_model,
+            n_layers=args.demo_layers, n_heads=args.demo_heads,
+            vocab=args.demo_vocab, seq_len=args.demo_seq_len)
+    meta = load_export(export_dir).meta
+    vocab = int((meta.get("net") or {}).get("vocab", 64))
+    tails = [int(x) for x in args.tail_lengths.split(",")]
+    # DISTINCT prompts (no shared prefix): every admission is a cold
+    # prefill, so the measured axis is the program-launch economics of
+    # batching itself, not prefix-cache sharing
+    prompts = make_trace(0, tails, args.streams, vocab)
+    legs = {}
+    for name, pb in (("serial", 1),
+                     ("batched", args.decode_prefill_batch)):
+        print(f"[prefill-compare] leg {name} (prefill_batch={pb}) ...",
+              flush=True)
+        server, thread, addr = _start_decode_server(
+            export_dir, args, None, prefix_cache=True,
+            prefill_batch=pb,
+            prefill_delay_ms=args.decode_prefill_delay_ms)
+        try:
+            probe = InferenceClient(addr)
+            # warm pass compiles every (n_seqs, token) bucket pair the
+            # trace touches; the measured pass is the steady state
+            run_trace(addr, prompts, args.gen_tokens,
+                      args.concurrency)
+            warm_compiles = [r.get("compiles")
+                             for r in probe.stats()["replicas"]]
+            st0 = probe.stats()
+            for r in server.replicas:
+                r.batcher.reset_intertoken()
+            res = run_trace(addr, prompts, args.gen_tokens,
+                            args.concurrency)
+            st = probe.stats()
+            probe.shutdown()
+            probe.close()
+        finally:
+            server.stop()
+            thread.join(timeout=10)
+        measured_compiles = [r.get("compiles")
+                            for r in st["replicas"]]
+        rep, rep0 = st["replicas"][0], st0["replicas"][0]
+        pf_tokens = rep["prefill_tokens"] - rep0["prefill_tokens"]
+        pf_s = rep["prefill_s"] - rep0["prefill_s"]
+        batches = rep["prefill_batches"] - rep0["prefill_batches"]
+        legs[name] = {
+            "prefill_batch": pb,
+            "prefill_delay_ms": args.decode_prefill_delay_ms,
+            "ok": res["ok"], "overloaded": res["overloaded"],
+            "errors": res["errors"],
+            "wall_s": res["wall_s"],
+            "outputs": [s["out"] if s else None
+                        for s in res["streams"]],
+            "prefill": {
+                "prompt_tokens": pf_tokens,
+                "program_wall_s": pf_s,
+                "batches": batches,
+                "mean_occupancy": (res["ok"] / batches
+                                   if batches else None),
+                "max_occupancy": rep["max_prefill_batch"],
+                "aggregate_tok_s": pf_tokens / pf_s if pf_s else None,
+            },
+            "ttft_ms": rep["ttft_ms"],
+            "zero_steady_state_recompiles":
+                warm_compiles == measured_compiles,
+            "compiles": measured_compiles,
+        }
+    serial, batched = legs["serial"], legs["batched"]
+    sp, bp = (serial["prefill"]["aggregate_tok_s"],
+              batched["prefill"]["aggregate_tok_s"])
+    speedup = bp / sp if sp and bp else None
+    s99, b99 = serial["ttft_ms"]["p99"], batched["ttft_ms"]["p99"]
+    return {
+        "bench": "serving",
+        "mode": "prefill-compare",
+        "decode": True,
+        "argv": sys.argv[1:],
+        "trace": {
+            "streams": args.streams,
+            "tail_lengths": tails,
+            "gen_tokens_per_stream": args.gen_tokens,
+            "concurrency": args.concurrency,
+        },
+        "model": {"net": meta.get("net"),
+                  "weight_dtype": meta.get("weight_dtype")},
+        "legs": {name: {k: v for k, v in leg.items()
+                        if k != "outputs"}
+                 for name, leg in legs.items()},
+        "byte_identical_output": (serial["outputs"]
+                                  == batched["outputs"]),
+        "aggregate_prefill_speedup": speedup,
+        "ttft_p99_ms": {"serial": s99, "batched": b99},
+        "acceptance": {
+            "aggregate_prefill_2x": (speedup is not None
+                                     and speedup >= 2.0),
+            "ttft_p99_not_worse": (s99 is not None and b99 is not None
+                                   and b99 <= s99),
+            "byte_identical_output": (serial["outputs"]
+                                      == batched["outputs"]),
+            "zero_steady_state_recompiles": (
+                serial["zero_steady_state_recompiles"]
+                and batched["zero_steady_state_recompiles"]),
+        },
+    }
 
 
 def make_mixed_workload(vocab: int, n_short: int, short_tokens: int,
@@ -1105,6 +1236,22 @@ def main(argv=None) -> int:
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="--mode trace single leg: disable the prefix "
                          "cache")
+    ap.add_argument("--prefill-compare", action="store_true",
+                    help="--decode: run the SAME concurrent prompt "
+                         "trace on a serial-admission server "
+                         "(prefill_batch=1) and a batched one "
+                         "(--decode-prefill-batch), verify "
+                         "byte-identical outputs, report aggregate "
+                         "prefill tok/s + TTFT p50/p99 per leg")
+    ap.add_argument("--decode-prefill-batch", type=int, default=8,
+                    help="--decode in-process server: prompts "
+                         "coalesced into one batched prefill program "
+                         "(1 = serial admission)")
+    ap.add_argument("--decode-prefill-delay-ms", type=float,
+                    default=2.0,
+                    help="--decode in-process server: how long the "
+                         "oldest pending prompt waits for company "
+                         "before a partial batch launches")
     ap.add_argument("--spec-compare", action="store_true",
                     help="--mode trace: run baseline (no draft, no "
                          "prefix cache) and optimized (both on) legs "
@@ -1127,13 +1274,17 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
 
-    if args.mode in ("trace", "mixed-trace"):
+    if args.prefill_compare or args.mode in ("trace", "mixed-trace"):
         if not args.decode:
-            ap.error(f"--mode {args.mode} is a --decode mode")
+            ap.error("--prefill-compare is a --decode mode"
+                     if args.prefill_compare
+                     else f"--mode {args.mode} is a --decode mode")
         import tempfile
 
         with tempfile.TemporaryDirectory() as td:
-            out = (trace_main(args, td) if args.mode == "trace"
+            out = (prefill_compare_main(args, td)
+                   if args.prefill_compare
+                   else trace_main(args, td) if args.mode == "trace"
                    else mixed_main(args, td))
         with open(args.out, "w") as f:
             json.dump(out, f, indent=1)

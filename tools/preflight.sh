@@ -454,18 +454,23 @@ PYEOF
   DECODE_RC=$?
   rm -rf "$DECODEDIR"
   echo "decode smoke rc=$DECODE_RC"
-  echo "## frontdoor smoke (disaggregated fleet: router + 1 prefill + 1 decode REAL processes, docs/SERVING.md 'Disaggregated serving')"
-  # the ISSUE 17 vertical end-to-end: DisaggregatedFleet spawns a real
-  # prefill subprocess and a real decode subprocess, router in the
+  echo "## frontdoor smoke (disaggregated fleet: router + 2 prefill + 1 decode REAL processes, docs/SERVING.md 'Disaggregated serving')"
+  # the ISSUE 17 vertical end-to-end: DisaggregatedFleet spawns real
+  # prefill subprocesses and a real decode subprocess, router in the
   # parent; three CONCURRENT client streams generate through the
-  # front door (prompt phase on the prefill replica, pages migrated
+  # front door (prompt phase on a prefill replica, pages migrated
   # over wire v2, token phase on the decode replica).  The gate
   # asserts greedy determinism across identical prompts, zero sheds,
   # and — via the collector file — that ONE client_generate trace
   # stitches >= 3 PROCESSES with zero orphans and carries the
   # page_migrate span; tools/traces.py --require-procs 3 then
   # confirms the same from the merged stream and prints the critical
-  # path
+  # path.  The batched-prefill additions (docs/SERVING.md "Batched
+  # prefill" / "Fleet prefix cache"): concurrent streams must COALESCE
+  # into a multi-sequence prefill batch (occupancy > 1 in the monitor
+  # JSONL), and a prompt prefilled on the cache authority must FLEET-
+  # HIT from the peer replica — shipped pages, byte-identical output,
+  # zero leaked leases — instead of recomputing the prefix
   FRONTDIR="$(mktemp -d)"
   JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$FRONTDIR" python - <<'PYEOF'
 import os, sys, threading, time
@@ -475,6 +480,7 @@ import numpy as np
 os.environ["THEANOMPI_TPU_TRACE"] = "1"  # before any child spawns
 from theanompi_tpu import monitor
 from theanompi_tpu.frontdoor.fleet import DisaggregatedFleet
+from theanompi_tpu.frontdoor.prefill import PrefillClient
 from theanompi_tpu.frontdoor.router import RouterClient
 from theanompi_tpu.models.base import ModelConfig
 from theanompi_tpu.models.transformer import TransformerLM
@@ -493,10 +499,11 @@ export_model(lm, export_dir, version=0)
 col = CollectorProcess(mondir)  # exports THEANOMPI_TPU_COLLECTOR
 try:
     with monitor.session(run_dir=mondir, stall_after=float("inf")), \
-         DisaggregatedFleet(export_dir, prefill=1, decode=1,
+         DisaggregatedFleet(export_dir, prefill=2, decode=1,
                             router_host="127.0.0.1", page_size=4,
                             pages_per_seq=8, max_seqs=4,
-                            prefill_buckets=(8,)) as fleet:
+                            prefill_buckets=(8,),
+                            prefill_delay_ms=250.0) as fleet:
         rng = np.random.default_rng(0)
         prompts = [rng.integers(0, 32, 5).astype(np.int32)
                    for _ in range(2)]
@@ -523,10 +530,80 @@ try:
         st = c.stats()
         c.close()
         assert st["streams"] >= 3 and st["shed"] == 0, st
-        time.sleep(2.0)  # let the role exporters flush their tails
+        # batched prefill: 3 concurrent streams round-robin over 2
+        # replicas, so ONE replica saw 2 inside the 250ms coalescing
+        # window — a multi-sequence batch (fewer batches than prompts)
+        addrs = fleet.prefill_group.addresses()
+        pstats = []
+        for a in addrs:
+            pc = PrefillClient(a)
+            pstats.append(pc.stats())
+            pc.close()
+        assert sum(s["prefills"] for s in pstats) >= 3, pstats
+        assert any(s["prefills"] > s["prefill_batches"]
+                   for s in pstats), \
+            f"no multi-sequence prefill batch formed: {pstats}"
+        # fleet prefix cache: prefill a FRESH prompt on the authority
+        # (replica 0), then the SAME prompt on the peer — the peer has
+        # never seen it, so its local prefix hit can only come from
+        # pages the authority shipped over the wire; byte-identical
+        # pages, and the lease is released (never leaked)
+        auth = fleet._authority_addr
+        peer = next(a for a in addrs if a != auth)
+        pnew = rng.integers(0, 32, 8).astype(np.int32)
+        c0, c1 = PrefillClient(auth), PrefillClient(peer)
+        try:
+            hits0 = c1.stats()["prefix_cache"]["hits"]
+            man0, k0, v0 = c0.prefill(pnew)
+            man1, k1, v1 = c1.prefill(pnew)
+            assert man0["first_token"] == man1["first_token"], \
+                (man0, man1)
+            # the shipped PREFIX page (pages axis 1) is byte-verbatim
+            # on the peer — shipped, not recomputed; suffix pages are
+            # extend-computed and only token-identity is pinned
+            assert np.array_equal(np.asarray(k0)[:, 0],
+                                  np.asarray(k1)[:, 0])
+            assert np.array_equal(np.asarray(v0)[:, 0],
+                                  np.asarray(v1)[:, 0])
+            st1 = c1.stats()
+            assert st1["prefix_cache"]["hits"] >= hits0 + 1, \
+                f"peer never fleet-hit the authority's prefix: {st1}"
+            st0 = c0.stats()
+            assert st0["fleet_cache_leases"] == 0, \
+                f"authority leaked a fleet-cache lease: {st0}"
+        finally:
+            c0.close()
+            c1.close()
+        time.sleep(3.0)  # let the role exporters flush their tails
+                         # (metric snapshots ship every ~2s)
     # the fleet file now carries client+router / prefill / decode
     cst = col.stats()
     assert cst and cst["events"] > 0 and cst["senders"] >= 3, cst
+    # monitor JSONL: the batched-prefill occupancy histogram and the
+    # fleet-cache hit/ship counters crossed the collector (snapshots
+    # are cumulative — take the max each series ever reported)
+    import json
+    occ = 0.0
+    fleet_hits = 0.0
+    ship_bytes = 0.0
+    for line in open(os.path.join(mondir, "fleet.jsonl")):
+        rec = json.loads(line)
+        if rec.get("event") != "metrics":
+            continue
+        for s in rec.get("snapshot", []):
+            if s["name"] == "frontdoor/prefill_batch_occupancy":
+                occ = max(occ, s.get("max") or 0.0)
+            elif (s["name"] == "frontdoor/fleet_cache_lookups_total"
+                  and s.get("labels", {}).get("result") == "hit"):
+                fleet_hits = max(fleet_hits, s["value"])
+            elif s["name"] == "decode/fleet_cache_ship_bytes_total":
+                ship_bytes = max(ship_bytes, s["value"])
+    assert occ > 1, \
+        f"prefill_batch_occupancy max {occ} <= 1 in monitor JSONL"
+    assert fleet_hits >= 1, \
+        "no fleet-cache hit reached the monitor JSONL"
+    assert ship_bytes > 0, \
+        "fleet-cache hit shipped zero page bytes"
     sys.path.insert(0, os.path.join(os.getcwd(), "tools"))
     import traces as traces_tool
     records = traces_tool.load_events(os.path.join(mondir,
@@ -545,7 +622,10 @@ try:
     print(f"frontdoor smoke OK: {st['streams']} streams through "
           f"router+prefill+decode, stitched trace spans "
           f"{len(traces_tool.processes_of(full[0]))} processes "
-          f"({len(full[0])} spans, 0 orphans, page_migrate present)")
+          f"({len(full[0])} spans, 0 orphans, page_migrate present), "
+          f"prefill batch occupancy max {occ:.0f}, "
+          f"{fleet_hits:.0f} fleet-cache hit(s) shipped "
+          f"{ship_bytes:.0f} page bytes")
 finally:
     col.stop()
 PYEOF
