@@ -108,6 +108,39 @@ def thread_leak_guard():
                     "exit) before returning")
 
 
+@pytest.fixture(autouse=True)
+def shm_segment_leak_guard():
+    """Shared-memory twin of the thread fence: every test must decref
+    what it leases — a leaked ``tmshm_*`` segment pins /dev/shm pages
+    for the rest of the session.  Segments owned by shard/worker
+    subprocesses a test spawned are swept by the dead-pid orphan probe
+    before we judge."""
+    from theanompi_tpu.parallel import shm
+
+    before = set(shm.segment_names())
+    yield
+    shm.release_all()
+    shm.sweep_orphans()
+    deadline = time.monotonic() + 2.0
+    while True:
+        leaked = [n for n in shm.segment_names() if n not in before]
+        if not leaked or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+        shm.sweep_orphans()
+    if leaked:
+        for n in leaked:  # unpin the suite before failing the test
+            try:
+                os.unlink(os.path.join("/dev/shm", n))
+            except OSError:
+                pass
+        pytest.fail(
+            f"test leaked {len(leaked)} shm segment(s): "
+            f"{', '.join(sorted(leaked))} — close the owning channel "
+            "(client.close(), server stop) or decref the lease before "
+            "returning")
+
+
 @pytest.fixture(params=["threaded", "selector"])
 def rpc_loop(request, monkeypatch):
     """Both RPC substrates (parallel/rpc.py, ISSUE 11): tests naming

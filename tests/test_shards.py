@@ -532,6 +532,7 @@ class TestVersionFence:
             g = ShardProcessGroup.__new__(ShardProcessGroup)
             g.host = "127.0.0.1"
             g._ports = [port]
+            g._socks = [None]
             g._lock = make_lock("test-group-lock")
             g._stopping = threading.Event()
             g.max_restarts = 0

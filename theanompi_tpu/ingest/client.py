@@ -65,7 +65,8 @@ from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
 from theanompi_tpu.ingest import protocol
 from theanompi_tpu.ingest.protocol import ingest_addresses  # re-export
 from theanompi_tpu.monitor import trace
-from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel import shm, wire
+from theanompi_tpu.parallel.rpc import unix_path as _unix_path
 from theanompi_tpu.parallel.rpc import wait_readable as _wait_readable
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
@@ -113,13 +114,16 @@ class _ReaderPipe:
     control-plane clients and the pull pipeline to one reader then
     cost one fd between them (``THEANOMPI_TPU_INGEST_MUX``)."""
 
-    def __init__(self, addr: str, transport=None):
+    def __init__(self, addr: str, transport=None,
+                 offer_shm: bool = True):
         from theanompi_tpu.parallel.service import _authkey
 
-        host, _, port = addr.rpartition(":")
         self.addr = addr
         self.wire: wire.WireOptions | None = None
         self.trace = False  # hello grant — batch pulls then carry ctx
+        #: the shm lane channel THIS pipe negotiated (None when riding
+        #: a mux transport, whose shared channel the transport owns)
+        self._own_shm = None
         self.fifo: deque = deque()  # (index, t_sent)
         if transport is not None:
             self.conn, pre = transport.connect_stream()
@@ -128,18 +132,27 @@ class _ReaderPipe:
                 self.trace = transport.trace
                 return  # negotiation inherited from the transport
         else:
-            self.conn = _MpClient((host or "127.0.0.1", int(port)),
-                                  authkey=_authkey())
+            p = _unix_path(addr)
+            if p is not None:
+                self.conn = _MpClient(p, authkey=_authkey())
+            else:
+                host, _, port = addr.rpartition(":")
+                self.conn = _MpClient((host or "127.0.0.1", int(port)),
+                                      authkey=_authkey())
         if os.environ.get("THEANOMPI_TPU_WIRE_PROTOCOL", "v2") == "v2":
             want = wire.WireOptions.from_env()
-            self.conn.send((wire.HELLO_OP, wire.hello_payload(want)))
+            offer = shm.client_offer() if offer_shm else None
+            self.conn.send((wire.HELLO_OP,
+                            wire.hello_payload(want, shm_offer=offer)))
             status, payload = self.conn.recv()
             if (status == "ok" and isinstance(payload, dict)
                     and payload.get("version") == wire.WIRE_VERSION):
+                self._own_shm = shm.client_channel(offer, payload)
                 self.wire = wire.WireOptions(
                     compression=payload.get("compression", "none"),
                     dtype=payload.get("dtype", "f32"),
-                    allow_pickle=want.allow_pickle)
+                    allow_pickle=want.allow_pickle,
+                    shm=self._own_shm)
                 self.trace = bool(payload.get("trace"))
 
     def send(self, msg) -> None:
@@ -158,6 +171,9 @@ class _ReaderPipe:
         return self.conn.recv()
 
     def close(self) -> None:
+        ch, self._own_shm = self._own_shm, None
+        if ch is not None:
+            ch.close()  # release leases the reader never acked
         try:
             self.conn.close()
         except OSError:
@@ -206,6 +222,10 @@ class RemoteBatchSource:
             != "v1"))
         #: addr -> rpc.MuxConnection; fetch thread + constructor only
         self._transports: dict = {}
+        #: offer the shared-memory batch lane to readers; a typed
+        #: ShmRefusal flips this off and every later pull goes in-band
+        #: (silent, never a stream failure)
+        self._shm_on = True
 
         # consumer-facing state (fetch thread produces, __next__
         # consumes)
@@ -412,7 +432,8 @@ class RemoteBatchSource:
             pipe = pipes.get(addr)
             if pipe is None:
                 pipe = pipes[addr] = _ReaderPipe(
-                    addr, transport=self._transport(addr))
+                    addr, transport=self._transport(addr),
+                    offer_shm=self._shm_on)
                 by_conn[pipe.conn] = pipe
             if trace.enabled():
                 # each pipelined pull roots its own trace at the send
@@ -442,7 +463,13 @@ class RemoteBatchSource:
         try:
             with monitor.span("ingest_pull", reader=pipe.addr):
                 status, payload = pipe.recv()
-        except CONNECTION_ERRORS:
+        except CONNECTION_ERRORS as e:
+            if isinstance(e, wire.ShmRefusal):
+                # a reply carried shm content this side must refuse:
+                # a LANE failure, not a reader failure — reconnect
+                # in-band without failing the reader over
+                self._drop_lane(pipe, pipes, by_conn, pending, resends)
+                return
             self._drop_pipe(pipe.addr, pipes, by_conn, pending,
                             resends)
             return
@@ -458,6 +485,14 @@ class RemoteBatchSource:
                 self._cond.notify_all()
             return
         err = str(payload)
+        if wire.ShmRefusal.__name__ in err:
+            # the reader refused our frame's shm content (its lane
+            # state is gone — restart, swept lease): requeue the pull
+            # and retry in-band.  Typed classification, same idiom as
+            # Overloaded below.
+            pipe.fifo.appendleft((idx, t_sent))
+            self._drop_lane(pipe, pipes, by_conn, pending, resends)
+            return
         from theanompi_tpu.serving.batcher import Overloaded
 
         if Overloaded.__name__ in err:
@@ -475,6 +510,23 @@ class RemoteBatchSource:
 
         raise ServiceError(
             f"ingest reader {pipe.addr} rejected batch {idx}: {err}")
+
+    def _drop_lane(self, pipe: _ReaderPipe, pipes, by_conn, pending,
+                   resends) -> None:
+        """A typed shm refusal: disable the lane for the whole stream,
+        drop only this PIPE (the reader itself is healthy — no
+        failover) and requeue everything that was in flight on it."""
+        self._shm_on = False
+        if self._mux:
+            t = self._transports.get(pipe.addr)
+            if t is not None:
+                t.disable_shm()
+        pipes.pop(pipe.addr, None)
+        by_conn.pop(pipe.conn, None)
+        lost = [i for i, _ in pipe.fifo]
+        pipe.close()
+        for i in lost:
+            self._requeue(i, pending, resends, delay=0.0)
 
     def _drop_pipe(self, addr: str, pipes, by_conn, pending, resends,
                    extra=()) -> None:

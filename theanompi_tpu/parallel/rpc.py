@@ -83,12 +83,33 @@ from typing import Any, Callable
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
 from theanompi_tpu.monitor import trace as _trace
-from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel import shm, wire
 
 __all__ = [
     "serve", "RpcHooks", "MuxConnection", "HandshakeTimeout",
-    "wait_readable", "set_nodelay",
+    "wait_readable", "set_nodelay", "unix_path", "have_af_unix",
 ]
+
+# -- address forms ----------------------------------------------------------
+
+#: same-host fleets may listen on an AF_UNIX socket instead of TCP
+#: loopback: ``serve(host="unix:/path")`` and the same string as a
+#: client address.  Platforms without AF_UNIX silently fall back to
+#: TCP (``127.0.0.1`` + the given port) — the degradation contract
+#: every lane here follows.
+UNIX_PREFIX = "unix:"
+
+
+def unix_path(host) -> str | None:
+    """The socket path of a ``unix:/path`` address form, or None for
+    every TCP form."""
+    if isinstance(host, str) and host.startswith(UNIX_PREFIX):
+        return host[len(UNIX_PREFIX):]
+    return None
+
+
+def have_af_unix() -> bool:
+    return hasattr(socket, "AF_UNIX")
 
 # -- knobs ------------------------------------------------------------------
 
@@ -418,7 +439,17 @@ def _serve_threaded(service, host: str, port: int,
     and an un-negotiated dropped connect leaked its handler)."""
     from multiprocessing.connection import Connection, Listener
 
-    listener = Listener((host, port), backlog=backlog)  # auth: below
+    path = unix_path(host)
+    if path is not None and not have_af_unix():  # pragma: no cover
+        path, host = None, "127.0.0.1"  # silent TCP fallback
+    if path is not None:
+        try:  # a stale socket file from a killed predecessor
+            os.unlink(path)
+        except OSError:
+            pass
+        listener = Listener(path, "AF_UNIX", backlog=backlog)
+    else:
+        listener = Listener((host, port), backlog=backlog)  # auth: below
     if ready_event is not None:
         ready_event.set()
     conns: set[Connection] = set()
@@ -524,10 +555,13 @@ def _serve_threaded(service, host: str, port: int,
                     # confirm v2 + options on the CURRENT protocol,
                     # then switch framing.  allow_mux=False: one
                     # handler thread cannot demultiplex — the client
-                    # falls back to one socket per stream.
+                    # falls back to one socket per stream.  allow_shm:
+                    # the finally below closes the lane channel, so
+                    # this loop may grant it.
                     try:
                         negotiated, hello_reply, _ = wire.accept_hello(
-                            args[0] if args else None, allow_mux=False)
+                            args[0] if args else None, allow_mux=False,
+                            allow_shm=True)
                     except wire.WireProtocolError as e:
                         if not reply(("err",
                                       f"{type(e).__name__}: {e}")):
@@ -543,9 +577,17 @@ def _serve_threaded(service, host: str, port: int,
                     reply(("ok", None))
                     stop_event.set()
                     try:  # unblock accept() so the serve loop exits
-                        socket.create_connection(
-                            (host if host != "0.0.0.0" else "127.0.0.1",
-                             port), timeout=2).close()
+                        if path is not None:
+                            s = socket.socket(socket.AF_UNIX,
+                                              socket.SOCK_STREAM)
+                            s.settimeout(2)
+                            s.connect(path)
+                            s.close()
+                        else:
+                            socket.create_connection(
+                                (host if host != "0.0.0.0"
+                                 else "127.0.0.1",
+                                 port), timeout=2).close()
                     except OSError:
                         pass
                     return
@@ -577,6 +619,12 @@ def _serve_threaded(service, host: str, port: int,
                     # charged as an error — not also a success
                     hooks.on_request(op, (time.monotonic() - t0) * 1e3)
         finally:
+            ch = getattr(wire_opts, "shm", None)
+            if ch is not None:
+                # connection teardown releases every lease whose ack
+                # never came back — the lane must not wait out the
+                # lease timeout for an orderly disconnect
+                ch.close()
             try:
                 conn.close()
             except OSError:
@@ -609,6 +657,11 @@ def _serve_threaded(service, host: str, port: int,
         for c in live:
             try:
                 c.close()
+            except OSError:
+                pass
+        if path is not None:
+            try:
+                os.unlink(path)
             except OSError:
                 pass
 
@@ -852,11 +905,24 @@ class _SelectorServer:
                                     max(2, min(4, max_workers)))
         self.hs_pool = _DaemonPool(f"rpc-hs-{plane}", 8)
         self.sel = selectors.DefaultSelector()
-        self.listener = socket.socket(socket.AF_INET,
-                                      socket.SOCK_STREAM)
-        self.listener.setsockopt(socket.SOL_SOCKET,
-                                 socket.SO_REUSEADDR, 1)
-        self.listener.bind((host, port))
+        path = unix_path(host)
+        if path is not None and not have_af_unix():  # pragma: no cover
+            path, host = None, "127.0.0.1"  # silent TCP fallback
+        self._unix_path = path
+        if path is not None:
+            try:  # a stale socket file from a killed predecessor
+                os.unlink(path)
+            except OSError:
+                pass
+            self.listener = socket.socket(socket.AF_UNIX,
+                                          socket.SOCK_STREAM)
+            self.listener.bind(path)
+        else:
+            self.listener = socket.socket(socket.AF_INET,
+                                          socket.SOCK_STREAM)
+            self.listener.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEADDR, 1)
+            self.listener.bind((host, port))
         self.listener.listen(backlog)
         self.listener.setblocking(False)
         self.sel.register(self.listener, selectors.EVENT_READ, "accept")
@@ -1089,7 +1155,8 @@ class _SelectorServer:
             # loop demultiplexes.
             try:
                 negotiated, hello_reply, mux = wire.accept_hello(
-                    args[0] if args else None, allow_mux=True)
+                    args[0] if args else None, allow_mux=True,
+                    allow_shm=True)
             except wire.WireProtocolError as e:
                 return self._reply_io(conn, st.sid,
                                       ("err",
@@ -1286,6 +1353,11 @@ class _SelectorServer:
         if n_streams:
             monitor.add_gauge("rpc/open_streams", -float(n_streams),
                               plane=self.hooks.plane)
+        ch = getattr(conn.wire_opts, "shm", None)
+        if ch is not None:
+            # release every lease this connection's acks never covered
+            # (lane teardown contract — same as the threaded loop)
+            ch.close()
         self.hooks.on_disconnect()
 
     def _shutdown(self) -> None:
@@ -1294,6 +1366,11 @@ class _SelectorServer:
         except (KeyError, ValueError):
             pass
         self.listener.close()
+        if self._unix_path is not None:
+            try:
+                os.unlink(self._unix_path)
+            except OSError:
+                pass
         for conn in list(self.conns.values()):
             self._close_conn(conn)
         for pool in (self.pool, self.ctl_pool, self.hs_pool):
@@ -1324,6 +1401,13 @@ def serve(service, host: str = "0.0.0.0", port: int = 0, *,
           backlog: int = 64) -> None:
     """Run ``service`` (anything with ``handle(op, *args)``) behind the
     RPC substrate until ``stop_event`` (or a ``shutdown`` op).
+
+    ``host`` may be the ``unix:/path`` address form: the listener
+    binds an AF_UNIX socket at ``/path`` (``port`` ignored) and the
+    same string works as a client address everywhere a ``host:port``
+    does.  Platforms without AF_UNIX silently fall back to TCP
+    loopback; Nagle never applies to unix sockets, so the
+    TCP_NODELAY latency contract is preserved by construction.
 
     ``loop`` picks the substrate (``THEANOMPI_TPU_RPC_LOOP``, default
     ``selector``).  ``max_workers`` caps the default executor pool —
@@ -1458,8 +1542,14 @@ class MuxConnection:
     def __init__(self, address, authkey: bytes | None = None,
                  wire_opts: wire.WireOptions | None = None):
         if isinstance(address, str):
-            host, _, port = address.rpartition(":")
-            address = (host or "127.0.0.1", int(port))
+            p = unix_path(address)
+            if p is not None:
+                # a str address IS the AF_UNIX form the stdlib
+                # Client/Listener understand
+                address = p
+            else:
+                host, _, port = address.rpartition(":")
+                address = (host or "127.0.0.1", int(port))
         self.address = address
         if authkey is None:
             from theanompi_tpu.parallel.service import _authkey
@@ -1475,6 +1565,10 @@ class MuxConnection:
         self._mux: bool | None = None  # guarded_by: self._lock
         self._wire: wire.WireOptions | None = None  # guarded_by: self._lock
         self._trace = False         # guarded_by: self._lock
+        #: offer the shared-memory lane on (re)connect; flipped off by
+        #: disable_shm() after a typed refusal, and every stream of
+        #: this transport reconnects in-band
+        self._shm_on = True         # guarded_by: self._lock
         self._streams: dict[int, _ChunkQueue] = {}  # guarded_by: self._lock
         self._next_sid = 1          # guarded_by: self._lock
         self._gen = 0               # guarded_by: self._lock
@@ -1489,9 +1583,12 @@ class MuxConnection:
 
         conn = Client(self.address, authkey=self._authkey)
         set_nodelay(conn)
+        offer = shm.client_offer() if self._shm_on else None
         try:
             conn.send((wire.HELLO_OP,
-                       dict(wire.hello_payload(self._want), mux=True)))
+                       dict(wire.hello_payload(self._want,
+                                               shm_offer=offer),
+                            mux=True)))
             status, payload = conn.recv()
         except Exception:
             conn.close()
@@ -1513,7 +1610,8 @@ class MuxConnection:
         self._wire = wire.WireOptions(
             compression=payload.get("compression", "none"),
             dtype=payload.get("dtype", "f32"),
-            allow_pickle=self._want.allow_pickle)
+            allow_pickle=self._want.allow_pickle,
+            shm=shm.client_channel(offer, payload))
         # the shared hello negotiated for every stream on this socket;
         # ServiceClient reads it when it skips its own hello
         self._trace = bool(payload.get("trace"))
@@ -1521,7 +1619,9 @@ class MuxConnection:
         threading.Thread(
             target=self._read_loop, args=(conn, self._gen),
             daemon=True,
-            name=f"rpc-mux-reader-{self.address[1]}-g{self._gen}",
+            name=(f"rpc-mux-reader-"
+                  f"{self.address[1] if isinstance(self.address, tuple) else 'unix'}"
+                  f"-g{self._gen}"),
         ).start()
 
     @property
@@ -1584,6 +1684,10 @@ class MuxConnection:
                     return  # a newer transport owns the streams now
                 self._conn = None
                 streams, self._streams = self._streams, {}
+                w, self._wire = self._wire, None
+            ch = getattr(w, "shm", None)
+            if ch is not None:
+                ch.close()  # leases the dead peer never acked
             for q in streams.values():
                 q.put_err(ConnectionResetError(
                     f"mux transport to {self.address} lost: {err}"))
@@ -1623,11 +1727,40 @@ class MuxConnection:
             except (OSError, EOFError, ValueError):
                 pass
 
+    def disable_shm(self) -> None:
+        """Degrade this transport to in-band frames after a typed
+        :class:`wire.ShmRefusal`: drop the current connection (its
+        streams fail with ``ConnectionResetError``, so their owners
+        reconnect through their ordinary retry loops) and never offer
+        the lane again from this transport."""
+        with self._lock:
+            if not self._shm_on:
+                return
+            self._shm_on = False
+            conn, self._conn = self._conn, None
+            streams, self._streams = self._streams, {}
+            w, self._wire = self._wire, None
+        ch = getattr(w, "shm", None)
+        if ch is not None:
+            ch.close()
+        for q in streams.values():
+            q.put_err(ConnectionResetError(
+                f"shm lane to {self.address} disabled; reconnect"))
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
             conn, self._conn = self._conn, None
             streams, self._streams = self._streams, {}
+            w, self._wire = self._wire, None
+        ch = getattr(w, "shm", None)
+        if ch is not None:
+            ch.close()
         for q in streams.values():
             q.put_err(EOFError("transport closed"))
         if conn is not None:

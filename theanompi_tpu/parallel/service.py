@@ -74,7 +74,7 @@ import numpy as np
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_lock
 from theanompi_tpu.monitor import trace
-from theanompi_tpu.parallel import rpc, wire
+from theanompi_tpu.parallel import rpc, shm, wire
 from theanompi_tpu.resilience import faults
 from theanompi_tpu.resilience.retry import CONNECTION_ERRORS, RetryPolicy
 
@@ -468,8 +468,14 @@ class ServiceClient:
                  protocol: str | None = None,
                  wire_opts: wire.WireOptions | None = None,
                  transport: "rpc.MuxConnection | None" = None):
-        host, _, port = address.rpartition(":")
-        self.address = (host or "127.0.0.1", int(port))
+        p = rpc.unix_path(address)
+        if p is not None:
+            # a str address IS the AF_UNIX form the stdlib Client
+            # understands; everything else is host:port TCP
+            self.address: Any = p
+        else:
+            host, _, port = address.rpartition(":")
+            self.address = (host or "127.0.0.1", int(port))
         self._authkey = authkey if authkey is not None else _authkey()
         self._retry = retry if retry is not None else _default_wire_retry()
         protocol = protocol or os.environ.get(
@@ -485,6 +491,13 @@ class ServiceClient:
         #: trace grant from the hello: only then does _call_once wrap
         #: requests in the wire.TRACE_OP context envelope
         self._trace = False
+        #: offer the shared-memory payload lane at hello time; a typed
+        #: ShmRefusal flips this off and the client silently retries
+        #: in-band (the lane's degradation contract)
+        self._shm_on = True
+        #: the lane channel THIS client negotiated (None when riding a
+        #: mux transport, whose shared channel the transport owns)
+        self._own_shm: "shm.ShmChannel | None" = None
         self._lock = threading.Lock()
         #: optional shared multiplexed transport (parallel/rpc.py):
         #: this client becomes one logical stream on the transport's
@@ -531,23 +544,35 @@ class ServiceClient:
         fallback is silent by design (old tmservers keep working)."""
         self._wire = None
         self._trace = False
+        self._own_shm = None
         if not self._want_v2:
             return
+        offer = shm.client_offer() if self._shm_on else None
         with self._lock:
             self._conn.send((wire.HELLO_OP,
-                             wire.hello_payload(self._wire_opts)))
+                             wire.hello_payload(self._wire_opts,
+                                                shm_offer=offer)))
             status, payload = self._conn.recv()
         if (status == "ok" and isinstance(payload, dict)
                 and payload.get("version") == wire.WIRE_VERSION):
+            # a legacy server's reply simply omits "shm" and the lane
+            # stays off — the same silent degradation as trace below
+            self._own_shm = shm.client_channel(offer, payload)
             self._wire = wire.WireOptions(
                 compression=payload.get("compression", "none"),
                 dtype=payload.get("dtype", "f32"),
-                allow_pickle=self._wire_opts.allow_pickle)
+                allow_pickle=self._wire_opts.allow_pickle,
+                shm=self._own_shm)
             # absent from a legacy server's reply — trace propagation
             # degrades silently, like compression/dtype
             self._trace = bool(payload.get("trace"))
 
     def _reconnect(self) -> None:
+        ch, self._own_shm = self._own_shm, None
+        if ch is not None:
+            # leases of the dying connection must not wait out the
+            # timeout; a shared mux channel is NOT ours to close
+            ch.close()
         with self._lock:
             try:
                 self._conn.close()
@@ -642,6 +667,19 @@ class ServiceClient:
                 payload = self._call_once(op, *args)
                 break
             except ServiceError as e:
+                if wire.ShmRefusal.__name__ in str(e):
+                    # the server refused shm content in OUR frame (its
+                    # lane state is gone — restart, swept lease, ...):
+                    # the op never dispatched, so re-sending is safe
+                    # even for at-most-once ops.  Disable the lane and
+                    # reconnect in-band — silent degradation, never a
+                    # caller-visible failure.
+                    self._disable_shm()
+                    last = e
+                    needs_rejoin = True
+                    monitor.inc("service/client_reconnects_total",
+                                op=op)
+                    continue
                 if needs_rejoin:
                     # typed marker: the service prefixes every err
                     # reply with the exception class name, so this
@@ -657,6 +695,11 @@ class ServiceClient:
                     monitor.inc("service/client_errors_total", op=op)
                 raise
             except CONNECTION_ERRORS as e:
+                if isinstance(e, wire.ShmRefusal):
+                    # the REPLY carried shm content this side must
+                    # refuse — drop the lane before reconnecting so
+                    # the re-negotiation omits the offer
+                    self._disable_shm()
                 if (op in AT_MOST_ONCE_OPS
                         and getattr(e, "_tm_sent", False)):
                     # the request reached the wire and the REPLY was
@@ -688,7 +731,19 @@ class ServiceClient:
                             (time.monotonic() - t0) * 1e3, op=op)
         return payload
 
+    def _disable_shm(self) -> None:
+        """Silently degrade to in-band frames: the next (re)connect
+        omits the shm offer.  A shared mux transport drops its lane
+        for every sibling stream — it cannot renegotiate per stream —
+        and their owners reconnect through their own retry loops."""
+        self._shm_on = False
+        if self._transport is not None:
+            self._transport.disable_shm()
+
     def close(self) -> None:
+        ch, self._own_shm = self._own_shm, None
+        if ch is not None:
+            ch.close()  # release leases the peer never acked
         # Deliberately does NOT take self._lock: an RPC thread wedged
         # in a blocking v1 recv holds the lock indefinitely, and
         # closing the fd out from under it is the only way another
@@ -839,8 +894,12 @@ class ShardedServiceClient:
         with self._router_lock:
             c = self._fence_clients[i]
         if c is None:
-            host, port = self._shard_clients[i].address
-            c = ServiceClient(f"{host}:{port}",
+            addr = self._shard_clients[i].address
+            # reconstruct the address string the client parses: the
+            # str form is an AF_UNIX path, the tuple form host:port
+            addr = (f"{rpc.UNIX_PREFIX}{addr}" if isinstance(addr, str)
+                    else f"{addr[0]}:{addr[1]}")
+            c = ServiceClient(addr,
                               transport=(self._transports[i]
                                          if self._transports else None))
             with self._router_lock:

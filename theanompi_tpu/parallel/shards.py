@@ -69,6 +69,7 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.analysis.lockgraph import make_condition, make_lock
+from theanompi_tpu.parallel import rpc
 from theanompi_tpu.parallel.partition import balanced_ranges
 from theanompi_tpu.parallel.service import (
     FenceBusy,
@@ -640,11 +641,21 @@ class ShardProcessGroup:
         self._lock = make_lock("ShardProcessGroup._lock")
         self._stopping = threading.Event()
         self._ports: list[int] = []
+        # THEANOMPI_TPU_UNIX_SOCKETS=1 puts the whole same-host fleet
+        # on AF_UNIX listeners (one socket file per shard); a port is
+        # still allocated per shard so a platform without AF_UNIX
+        # silently falls back to the TCP form.
+        use_unix = (os.environ.get("THEANOMPI_TPU_UNIX_SOCKETS") == "1"
+                    and rpc.have_af_unix())
+        self._socks: list[str | None] = []
         self._procs: list[subprocess.Popen] = []  # guarded_by: self._lock
         self._restarts: dict[int, int] = {}       # guarded_by: self._lock
         for i in range(n_shards):
             port = _free_port()
             self._ports.append(port)
+            self._socks.append(
+                f"/tmp/tmshard_{os.getpid()}_{i}.sock" if use_unix
+                else None)
             self._procs.append(self._spawn(i, port))
         self._wait_ready(ready_timeout_s)
         self._watcher = threading.Thread(
@@ -653,7 +664,8 @@ class ShardProcessGroup:
 
     @property
     def addresses(self) -> list[str]:
-        return [f"{self.host}:{p}" for p in self._ports]
+        return [f"{rpc.UNIX_PREFIX}{s}" if s else f"{self.host}:{p}"
+                for s, p in zip(self._socks, self._ports)]
 
     @property
     def server_addr(self) -> str:
@@ -661,8 +673,10 @@ class ShardProcessGroup:
         return ",".join(self.addresses)
 
     def _spawn(self, index: int, port: int) -> subprocess.Popen:
+        sock = self._socks[index] if self._socks else None
+        host = f"{rpc.UNIX_PREFIX}{sock}" if sock else self.host
         cmd = [sys.executable, "-m", "theanompi_tpu.parallel.shards",
-               "--host", self.host, "--port", str(port),
+               "--host", host, "--port", str(port),
                "--shard-index", str(index)]
         if self.platform:
             cmd += ["--platform", self.platform]
@@ -774,6 +788,12 @@ class ShardProcessGroup:
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait(timeout=5)
+        for s in getattr(self, "_socks", []):
+            if s is not None:  # a hard-killed shard leaves its file
+                try:
+                    os.unlink(s)
+                except OSError:
+                    pass
 
     def __enter__(self) -> "ShardProcessGroup":
         return self

@@ -59,6 +59,7 @@ import numpy as np
 
 from theanompi_tpu import monitor
 from theanompi_tpu.monitor import trace as _trace
+from theanompi_tpu.parallel import shm as _shm
 
 try:  # jax dependency; the bf16 wire dtype needs it as a numpy dtype
     import ml_dtypes
@@ -110,6 +111,16 @@ class WireProtocolError(WireError):
     """Version/negotiation mismatch (not a per-frame problem)."""
 
 
+class ShmRefusal(WireDecodeError):
+    """A shared-memory descriptor or piggybacked ack this peer must
+    refuse: stale generation, foreign segment, double decref, expired
+    lease, or shm content on a connection that negotiated no lane.
+    The message leads with the underlying :mod:`.shm` error's class
+    name, so clients classify it the same way they classify
+    ``SessionDisplaced`` — and respond by disabling the lane and
+    retrying in-band, never by failing the caller."""
+
+
 @dataclasses.dataclass(frozen=True)
 class WireOptions:
     """Per-connection defaults for frame encoding.
@@ -123,6 +134,11 @@ class WireOptions:
     compression: str = "none"       # 'none' | 'zlib'
     dtype: str = "f32"              # 'f32' | 'bf16'
     allow_pickle: bool = True
+    #: the connection's negotiated shared-memory lane (an
+    #: ``shm.ShmChannel``), or None for plain in-band v2.  Excluded
+    #: from equality: two connections with the same codec options are
+    #: codec-equal regardless of their private lanes.
+    shm: Any = dataclasses.field(default=None, compare=False)
 
     def __post_init__(self):
         if self.compression not in ("none", "zlib"):
@@ -253,10 +269,44 @@ def _encode_node(obj: Any, bufs: list, opts: WireOptions, stats: WireStats):
                 pickle.dumps(obj, protocol=2)).decode("ascii")}
 
 
+def _array_bytes_view(wire: np.ndarray):
+    """Zero-copy byte view of a C-contiguous array, via the
+    same-width-uint reinterpretation for dtypes outside the buffer
+    protocol (bfloat16)."""
+    try:
+        return memoryview(wire).cast("B")
+    except (ValueError, TypeError):
+        return memoryview(
+            wire.view(np.dtype(f"u{wire.dtype.itemsize}"))).cast("B")
+
+
 def _encode_array(arr: np.ndarray, bufs: list, opts: WireOptions,
                   stats: WireStats) -> dict:
     orig_dtype = arr.dtype
     stats.pre_bytes += arr.nbytes
+    # out-of-band lane: when this frame holds a lease (encode_frame
+    # allocated one off the connection's ShmChannel), large leaves are
+    # copied ONCE into the shared segment at their ORIGINAL dtype — no
+    # bf16 re-dtype, no zlib — so delivery is bit-exact and the
+    # receiver's mapping is the only other touch.  The lease rides
+    # WireStats because RawArrays leaves encode under _RAW_OPTS, not
+    # the connection's opts, and must still go out-of-band.
+    lease = getattr(stats, "_shm_lease", None)
+    if (lease is not None and arr.nbytes
+            and arr.nbytes >= stats._shm_min):
+        wire = arr if arr.flags["C_CONTIGUOUS"] \
+            else np.ascontiguousarray(arr)
+        off = lease.put(_array_bytes_view(wire))
+        if off is not None:
+            stats._shm_oob += arr.nbytes
+            stats.n_buffers += 1
+            return {"t": "nd", "dtype": orig_dtype.name,
+                    "shape": list(arr.shape), "rawlen": arr.nbytes,
+                    "comp": "none",
+                    "shm": [lease.name, off, arr.nbytes,
+                            lease.generation]}
+        # segment full (scan undercounted a non-eligible duplicate or
+        # the cap clipped the alloc): this leaf ships in-band
     wire = arr
     wire_dtype = orig_dtype
     if (opts.dtype == "bf16" and orig_dtype == np.float32
@@ -311,12 +361,27 @@ def _decode_node(node: Any, bufs: list, opts: WireOptions) -> Any:
     if t == "np0":
         return np.dtype(node["dtype"]).type(node["v"])
     if t == "nd":
-        return _decode_array(node, bufs)
+        return _decode_array(node, bufs, opts)
     if t == "raw":
         # a raw batch frame decodes to a plain tuple of arrays; each
         # element must be an array node (malformed ones raise the same
         # typed error as any corrupt skeleton)
-        return tuple(_decode_array(v, bufs) for v in node["v"])
+        return tuple(_decode_array(v, bufs, opts) for v in node["v"])
+    if t == "shmenv":
+        # the lane's piggybacked decref acks: applied to OUR arena
+        # before the payload decodes.  Refusals (double decref, stale
+        # generation, foreign segment) are typed and per-frame — the
+        # connection survives, the client disables its lane.
+        ch = getattr(opts, "shm", None)
+        if ch is None:
+            raise ShmRefusal(
+                "frame piggybacks shared-memory acks but this "
+                "connection negotiated no shm lane")
+        try:
+            ch.apply_acks(node.get("acks"))
+        except _shm.ShmError as e:
+            raise ShmRefusal(f"{type(e).__name__}: {e}") from e
+        return _decode_node(node["v"], bufs, opts)
     if t == "tuple":
         return tuple(_decode_node(v, bufs, opts) for v in node["v"])
     if t == "list":
@@ -359,7 +424,58 @@ def _resolve_namedtuple(mod: str, qual: str):
     return obj
 
 
-def _decode_array(node: dict, bufs: list) -> np.ndarray:
+def _decode_shm_array(node: dict, desc: Any,
+                      opts: WireOptions | None) -> np.ndarray:
+    """Decode one out-of-band leaf: map its segment read-only via the
+    connection's lane (the map queues the decref ack) and view the
+    descriptor's byte range zero-copy.  Every lane failure is a typed
+    :class:`ShmRefusal` naming the underlying refusal class."""
+    ch = getattr(opts, "shm", None) if opts is not None else None
+    if ch is None:
+        raise ShmRefusal(
+            "frame carries shared-memory descriptors but this "
+            "connection negotiated no shm lane")
+    try:
+        name, off, length, gen = desc
+        name, off, length, gen = str(name), int(off), int(length), int(gen)
+        shape = tuple(int(d) for d in node["shape"])
+        dtype = np.dtype(node["dtype"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ShmRefusal(f"malformed shm descriptor node: {node!r}") from e
+    if length > MAX_BUFFER_BYTES or off < 0:
+        raise ShmRefusal(
+            f"shm descriptor range [{off}, {off + length}) refused")
+    try:
+        m = ch.map_for_read(name, gen)
+    except _shm.ShmError as e:
+        raise ShmRefusal(f"{type(e).__name__}: {e}") from e
+    if off + length > len(m):
+        raise ShmRefusal(
+            f"shm descriptor [{off}, {off + length}) exceeds the "
+            f"{len(m)}-byte segment {name}")
+    if dtype.itemsize == 0 or length % dtype.itemsize:
+        raise ShmRefusal(
+            f"shm leaf of {length} bytes is not a whole number of "
+            f"{dtype} items")
+    try:
+        # PROT_READ mapping -> the view arrives read-only, matching
+        # the in-band frombuffer path; the mmap stays alive via the
+        # view's base chain even after the owner unlinks the name
+        arr = np.frombuffer(m, dtype=dtype, count=length // dtype.itemsize,
+                            offset=off).reshape(shape)
+    except ValueError as e:
+        raise ShmRefusal(
+            f"shm leaf does not reshape to {shape}: {e}") from e
+    if monitor.enabled():
+        monitor.inc("shm/oob_bytes_total", length, dir="recv")
+    return arr
+
+
+def _decode_array(node: dict, bufs: list,
+                  opts: WireOptions | None = None) -> np.ndarray:
+    desc = node.get("shm") if isinstance(node, dict) else None
+    if desc is not None:
+        return _decode_shm_array(node, desc, opts)
     try:
         idx = int(node["i"])
         rawlen = int(node["rawlen"])
@@ -412,6 +528,17 @@ def _decode_array(node: dict, bufs: list) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _scan_shm_bytes(msg: Any, min_b: int) -> int:
+    """Segment size one frame needs: the 64-byte-aligned sum of every
+    lane-eligible leaf (``nbytes >= min_b``).  A pre-pass so the frame
+    leases exactly one segment, sized once."""
+    total = 0
+    for a in _iter_arrays(msg):
+        if a.nbytes >= min_b:
+            total += -(-a.nbytes // 64) * 64 + 64
+    return total
+
+
 def encode_frame(msg: Any, opts: WireOptions
                  ) -> tuple[bytes, list, WireStats]:
     """``msg`` (any pytree of JSON-ables + ndarrays) -> (header+skeleton
@@ -419,8 +546,36 @@ def encode_frame(msg: Any, opts: WireOptions
     source arrays wherever the layout allows — the zero-copy path."""
     stats = WireStats()
     bufs: list = []
+    ch = getattr(opts, "shm", None)
+    lease = None
+    if ch is not None and ch.send_ok:
+        want = _scan_shm_bytes(msg, _shm.min_bytes())
+        if want:
+            lease = ch.alloc(want)
+        if lease is not None:
+            stats._shm_lease = lease
+            stats._shm_min = _shm.min_bytes()
+            stats._shm_oob = 0
+    try:
+        tree = _encode_node(msg, bufs, opts, stats)
+    except BaseException:
+        if lease is not None:
+            ch.cancel(lease)
+        raise
+    if lease is not None and not lease.used:
+        # every eligible leaf fell back in-band — return the segment
+        # now instead of waiting out its lease
+        ch.cancel(lease)
+    elif lease is not None and monitor.enabled():
+        monitor.inc("shm/oob_bytes_total", stats._shm_oob, dir="send")
+    if ch is not None:
+        # piggyback the decref acks for segments WE mapped since the
+        # last outgoing frame — the other half of the lane's refcount
+        acks = ch.drain_acks()
+        if acks:
+            tree = {"t": "shmenv", "acks": acks, "v": tree}
     skeleton = json.dumps(
-        _encode_node(msg, bufs, opts, stats),
+        tree,
         separators=(",", ":")).encode("utf-8")
     stats.pre_bytes += len(skeleton)
     flags = 0
@@ -499,7 +654,18 @@ def decode_frame(head: bytes, bufs: list,
         tree = json.loads(skeleton.decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
         raise WireDecodeError(f"corrupt frame skeleton: {e}") from e
-    return _decode_node(tree, bufs, opts)
+    ch = getattr(opts, "shm", None)
+    if ch is None:
+        return _decode_node(tree, bufs, opts)
+    # frame-scope the lane's map cache: a (segment, generation) pair
+    # is referenced by exactly ONE frame, so once this decode returns
+    # the mapping's only owners are the decoded views — their death
+    # fires the decref ack that lets the sender recycle the segment
+    ch.begin_frame()
+    try:
+        return _decode_node(tree, bufs, opts)
+    finally:
+        ch.end_frame()
 
 
 def recv_msg(conn, opts: WireOptions | None = None,
@@ -609,25 +775,42 @@ HELLO_OP = "wire_hello"
 TRACE_OP = "wire_trace_ctx"
 
 
-def hello_payload(opts: WireOptions, trace: bool | None = None) -> dict:
+def hello_payload(opts: WireOptions, trace: bool | None = None,
+                  shm_offer: dict | None = None) -> dict:
     """The client's hello.  ``trace=None`` (every existing caller)
     auto-requests trace propagation when tracing is enabled in this
-    process — one switch lights up every client in the fleet."""
+    process — one switch lights up every client in the fleet.
+
+    ``shm_offer`` (``shm.client_offer()``) asks for the shared-memory
+    payload lane: it carries the same-host proof (boot-id + uid + a
+    nonce the grant must echo), riding the HMAC-authenticated hello.
+    A legacy server ignores the key; a remote server refuses it —
+    both silently, the same degradation contract as mux."""
     out = {"version": WIRE_VERSION, "compression": opts.compression,
            "dtype": opts.dtype}
     if trace is None:
         trace = _trace.enabled()
     if trace:
         out["trace"] = True
+    if shm_offer:
+        out["shm"] = shm_offer
     return out
 
 
-def accept_hello(payload: Any, allow_mux: bool = False
-                 ) -> tuple[WireOptions, dict, bool]:
+def accept_hello(payload: Any, allow_mux: bool = False,
+                 allow_shm: bool = False) -> tuple[WireOptions, dict, bool]:
     """Server side: validate a hello payload, returning the negotiated
     options, the reply dict, and whether connection multiplexing was
     granted.  Unknown/newer options degrade to the safe defaults
     rather than failing the connection.
+
+    ``allow_shm``: a server loop that closes its connections' lane
+    channels on teardown may grant the shared-memory payload lane —
+    ``shm.server_grant`` checks the offer's same-host proof (boot-id
+    + uid) and the granted channel lands on the returned options'
+    ``shm`` field.  Refusal just omits the key from the reply: old
+    clients never sent the offer, old servers never echo it, and a
+    remote peer falls back to in-band bytes silently.
 
     ``mux`` (``parallel/rpc.py``): a client may request stream
     multiplexing — many logical request/reply streams framed over one
@@ -650,9 +833,13 @@ def accept_hello(payload: Any, allow_mux: bool = False
         comp = "none"
     if dtype not in ("f32", "bf16"):
         dtype = "f32"
+    shm_ch = shm_reply = None
+    if allow_shm and "shm" in payload:
+        shm_ch, shm_reply = _shm.server_grant(payload.get("shm"))
     # the pickle escape stays OFF for frames the server decodes: an
     # authenticated-but-hostile peer must not reach pickle.loads
-    opts = WireOptions(compression=comp, dtype=dtype, allow_pickle=False)
+    opts = WireOptions(compression=comp, dtype=dtype, allow_pickle=False,
+                       shm=shm_ch)
     mux = bool(allow_mux and payload.get("mux"))
     # the grant is bilateral: the client asked AND this server has
     # tracing on — a reply without the key tells the client to never
@@ -661,4 +848,6 @@ def accept_hello(payload: Any, allow_mux: bool = False
                                            and _trace.enabled()))
     if mux:
         reply["mux"] = True
+    if shm_reply is not None:
+        reply["shm"] = shm_reply
     return opts, reply, mux

@@ -345,11 +345,15 @@ def run_sharded(args) -> int:
     # monitor JSONL: per-shard traffic (shard_exchange spans for every
     # shard) + the recovery events (client reconnect, shard relaunch)
     served, names = set(), set()
+    shm_oob = 0.0
     if snapshot_path and os.path.exists(snapshot_path):
         with open(snapshot_path) as f:
             for line in f:
                 rec = json.loads(line)
                 names.add(rec.get("name"))
+                if rec.get("name") == "shm/oob_bytes_total":
+                    shm_oob = max(shm_oob,
+                                  float(rec.get("value") or 0.0))
                 if (rec.get("name") == "span_ms"
                         and rec.get("labels", {}).get("name")
                         == "shard_exchange" and rec.get("count", 0) > 0):
@@ -366,8 +370,333 @@ def run_sharded(args) -> int:
             print(f"[bench_exchange] FAIL: {needed} missing from the "
                   f"monitor JSONL ({snapshot_path})", file=sys.stderr)
             ok = False
+    # shm-lane evidence (ISSUE 20): a same-host shard fleet must have
+    # granted the lane and shipped the big leaves out-of-band — the
+    # client side of both counters lands in THIS process's snapshot
+    from theanompi_tpu.parallel import shm
+
+    if shm.enabled() and shm.available():
+        if "shm/grants_total" not in names or shm_oob <= 0:
+            print(f"[bench_exchange] FAIL: no shm-lane evidence in "
+                  f"the monitor JSONL ({snapshot_path}): grants "
+                  f"{'present' if 'shm/grants_total' in names else 'missing'}, "
+                  f"oob_bytes {shm_oob:.0f} — same-host shards should "
+                  "have granted the lane", file=sys.stderr)
+            ok = False
     print(f"[bench_exchange] shard smoke {'PASS' if ok else 'FAIL'}",
           flush=True)
+    return 0 if ok else 1
+
+
+def run_shm_compare(args) -> int:
+    """``--shm-compare`` (ISSUE 20): the shared-memory-lane
+    comparison across the three same-host planes, one committed
+    artifact (``artifacts/BENCH_shm_smoke.json``).
+
+    Exchange plane: the full parameter tree against ONE real shard
+    process — in-band wire v2 vs the negotiated shm lane, identical
+    exchange schedule, every round's merged tree sha256-checked
+    across legs, each leg against a FRESH server process.  The shm
+    leg ends with the lane FORCE-DISABLED mid-run on the live
+    client (the refusal recovery path: drop the lane, reconnect
+    without an offer): the tail exchanges must stay byte-identical
+    with ZERO out-of-band growth — the silent-fallback proof.  A
+    separate kill leg SIGKILLs the server between an exchange and
+    its piggybacked ack (so its reply segments are still leased),
+    then asserts the dead peer's segments sweep to zero.
+
+    Ingest and serving planes ride the sibling tools' legs
+    (``bench_ingest.shm_compare_leg`` /
+    ``bench_serving.shm_compare_leg``) so each plane's measurement
+    lives next to its own bench.
+
+    ``--smoke`` enforces the acceptance bars: >= 25% exchange wall
+    cut, >= 1.3x ingest img/s, byte identity on every plane, lane
+    evidence in the monitor registry, zero leaked segments after
+    every leg including the kill leg."""
+    import hashlib
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    os.environ.setdefault("THEANOMPI_TPU_SERVICE_KEY", "bench-exchange")
+    os.environ.setdefault(
+        "THEANOMPI_TPU_MONITOR",
+        os.path.join(REPO, "artifacts", "bench_exchange_monitor"))
+
+    from theanompi_tpu import monitor
+    from theanompi_tpu.parallel import shm, wire
+    from theanompi_tpu.parallel.shards import (
+        ShardProcessGroup,
+        ShardedEASGD,
+    )
+
+    if not (shm.enabled() and shm.available()):
+        print("[bench_exchange] FAIL: the shm lane is disabled or "
+              "/dev/shm is unavailable on this host", file=sys.stderr)
+        return 1
+
+    tree = resnet50_like_tree(int(args.params))
+    n_params = tree_params(tree)
+    n_exchanges = max(3, args.exchanges)
+    tail = 2  # post-force-disable exchanges (the fallback proof)
+    print(f"[bench_exchange] shm-compare: {n_params/1e6:.1f}M params, "
+          f"{len(tree)} leaves, {tree_nbytes(tree)/1e6:.1f} MB f32, "
+          f"{n_exchanges} timed + {tail} fallback exchanges/leg",
+          flush=True)
+
+    # exact in-band wire bytes (the copied-bytes ledger baseline):
+    # the same frames the K=1 router sends/receives, no lane attached
+    opts = wire.WireOptions.from_env()
+    flat, _ = jax.tree.flatten(tree)
+    _, _, st_req = wire.encode_frame(
+        ("shard_exchange", "bench-shm", flat, "cid", 1), opts)
+    _, _, st_rep = wire.encode_frame(("ok", flat), opts)
+    wire_bytes = st_req.post_bytes + st_rep.post_bytes
+
+    keys = sorted(tree)
+
+    def tree_digest(t: dict) -> str:
+        h = hashlib.sha256()
+        for k in keys:
+            h.update(np.asarray(t[k]).tobytes())
+        return h.hexdigest()
+
+    # lazy registry lookup: monitor.session() swaps in a FRESH
+    # registry on activation, so a handle captured here would read
+    # the stale pre-session one (and count nothing)
+    val = lambda name, **lb: (
+        monitor.registry().value(name, **lb) or 0.0)
+    oob_total = lambda: (val("shm/oob_bytes_total", dir="send")
+                         + val("shm/oob_bytes_total", dir="recv"))
+    pre_segments = set(shm.segment_names())
+    prior_lane = os.environ.get("THEANOMPI_TPU_WIRE_SHM")
+
+    def exchange_leg(lane: str) -> dict:
+        """One fresh-server leg: warm + timed + tail exchanges, every
+        merged tree digested.  ``lane`` toggles the hello offer for
+        BOTH sides (the shard subprocess inherits the environment)."""
+        os.environ["THEANOMPI_TPU_WIRE_SHM"] = lane
+        grants0 = val("shm/grants_total", role="client")
+        oob0 = oob_total()
+        digests: list[str] = []
+        walls: list[float] = []
+        group = ShardProcessGroup(1, max_restarts=1)
+        try:
+            srv = ShardedEASGD(group.addresses, tree, alpha=0.5,
+                               session_id=f"bench-shm-{lane}")
+            try:
+                out = srv.exchange(tree)  # warm: jit + session setup
+                digests.append(tree_digest(out))
+                for _ in range(n_exchanges):
+                    t0 = time.monotonic()
+                    out = srv.exchange(tree)
+                    walls.append((time.monotonic() - t0) * 1e3)
+                    digests.append(tree_digest(out))
+                oob_tail0 = oob_total()
+                if lane == "1":
+                    # force-disable mid-run on the LIVE client: the
+                    # same degrade path a typed refusal takes — drop
+                    # the lane, reconnect without an offer
+                    for c in srv._shard_clients:
+                        c._disable_shm()
+                        if getattr(c, "_transport", None) is None:
+                            try:
+                                c._conn.close()
+                            except OSError:
+                                pass
+                for _ in range(tail):
+                    out = srv.exchange(tree)
+                    digests.append(tree_digest(out))
+                oob_tail_growth = oob_total() - oob_tail0
+            finally:
+                srv.close()
+        finally:
+            group.stop()
+        oob = oob_total() - oob0
+        leg = {
+            "wall_ms_mean": round(float(np.mean(walls)), 2),
+            "wall_ms_min": round(float(np.min(walls)), 2),
+            "n_exchanges": n_exchanges,
+            "digests": digests,
+            "shm_grants": int(val("shm/grants_total", role="client")
+                              - grants0),
+            "oob_bytes": int(oob),
+            "oob_bytes_per_exchange": int(oob / (n_exchanges + 1)),
+            "oob_tail_growth": int(oob_tail_growth),
+        }
+        print(f"[bench_exchange] shm-compare "
+              f"{'shm' if lane == '1' else 'in_band'}: "
+              f"{leg['wall_ms_mean']:.0f} ms/exchange mean, "
+              f"{leg['oob_bytes']/1e6:.1f} MB out-of-band", flush=True)
+        return leg
+
+    def kill_leg() -> dict:
+        """SIGKILL the server while its reply segments are still
+        leased (the ack rides the client's NEXT frame, which never
+        comes), then prove the dead peer's segments sweep to zero."""
+        os.environ["THEANOMPI_TPU_WIRE_SHM"] = "1"
+        group = ShardProcessGroup(1, max_restarts=0)
+        try:
+            srv = ShardedEASGD(group.addresses, tree, alpha=0.5,
+                               session_id="bench-shm-kill")
+            try:
+                srv.exchange(tree)
+                srv.exchange(tree)
+                orphans_before = len(
+                    [n for n in shm.segment_names()
+                     if n not in pre_segments])
+                group.kill_shard(0)
+            finally:
+                try:
+                    srv.close()
+                except Exception:
+                    pass
+        finally:
+            group.stop()
+        shm.release_all()
+        swept = shm.sweep_orphans()
+        leaked = [n for n in shm.segment_names()
+                  if n not in pre_segments]
+        out = {"leased_at_kill": orphans_before,
+               "swept": int(swept or 0),
+               "leaked_after_sweep": len(leaked)}
+        print(f"[bench_exchange] shm-compare kill leg: {out}",
+              flush=True)
+        return out
+
+    planes: dict[str, dict] = {}
+    with monitor.session():
+        try:
+            in_band = exchange_leg("0")
+            lane = exchange_leg("1")
+            kill = kill_leg()
+        finally:
+            if prior_lane is None:
+                os.environ.pop("THEANOMPI_TPU_WIRE_SHM", None)
+            else:
+                os.environ["THEANOMPI_TPU_WIRE_SHM"] = prior_lane
+        wall_cut = 1.0 - lane["wall_ms_mean"] / in_band["wall_ms_mean"]
+        planes["exchange"] = {
+            "plane": "exchange",
+            "n_params": n_params,
+            "wire_bytes_per_exchange_in_band": wire_bytes,
+            "legs": {"in_band": in_band, "shm": lane},
+            "wall_cut_shm_vs_in_band": round(wall_cut, 4),
+            "byte_identical": in_band["digests"] == lane["digests"],
+            # payload bytes that left the socket path entirely per
+            # exchange (receiver maps instead of copying off the wire)
+            "socket_bytes_saved_per_exchange":
+                lane["oob_bytes_per_exchange"],
+            "kill_leg": kill,
+        }
+        print(f"[bench_exchange] exchange plane: shm cuts "
+              f"{wall_cut:.1%} of the in-band wall", flush=True)
+
+        # sibling planes: same artifact, each leg owned by its bench
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import bench_ingest
+        import bench_serving
+
+        planes["ingest"] = bench_ingest.shm_compare_leg(
+            samples=4096 if args.smoke else 8192)
+        print(f"[bench_exchange] ingest plane: shm "
+              f"{planes['ingest']['img_s_ratio_shm_over_in_band']:.2f}"
+              "x in-band img/s", flush=True)
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            planes["serving"] = bench_serving.shm_compare_leg(td)
+        print(f"[bench_exchange] serving plane: shm wall delta "
+              f"{planes['serving']['wall_delta_pct']:+.1f}%",
+              flush=True)
+
+    leaked_final = [n for n in shm.segment_names()
+                    if n not in pre_segments]
+    # digests are leg-internal evidence; keep the artifact readable
+    for leg in planes["exchange"]["legs"].values():
+        leg.pop("digests", None)
+    out_doc = {
+        "bench": "shm_lane",
+        "backend": "cpu",
+        "n_params": n_params,
+        "n_leaves": len(tree),
+        "tree_mb_f32": round(tree_nbytes(tree) / 1e6, 2),
+        "planes": planes,
+        "leaked_segments_final": len(leaked_final),
+    }
+    tag = args.tag or "shm_smoke"
+    path = args.out or os.path.join(REPO, "artifacts",
+                                    f"BENCH_{tag}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out_doc, f, indent=1)
+    print(f"[bench_exchange] wrote {path}", flush=True)
+
+    if not args.smoke:
+        return 0
+    ok = True
+    ex = planes["exchange"]
+    if not ex["byte_identical"]:
+        print("[bench_exchange] FAIL: shm exchange leg diverged from "
+              "the in-band leg (byte identity)", file=sys.stderr)
+        ok = False
+    if ex["wall_cut_shm_vs_in_band"] < 0.25:
+        print(f"[bench_exchange] FAIL: shm wall cut "
+              f"{ex['wall_cut_shm_vs_in_band']:.1%} < 25%",
+              file=sys.stderr)
+        ok = False
+    legs = ex["legs"]
+    if legs["shm"]["shm_grants"] < 1 or legs["shm"]["oob_bytes"] <= 0:
+        print("[bench_exchange] FAIL: shm leg shows no lane traffic "
+              f"({legs['shm']})", file=sys.stderr)
+        ok = False
+    if legs["in_band"]["oob_bytes"] != 0 \
+            or legs["in_band"]["shm_grants"] != 0:
+        print("[bench_exchange] FAIL: in-band leg negotiated the lane "
+              f"({legs['in_band']})", file=sys.stderr)
+        ok = False
+    if legs["shm"]["oob_tail_growth"] != 0:
+        print("[bench_exchange] FAIL: out-of-band bytes grew after "
+              "the mid-run force-disable — the fallback is not "
+              "in-band", file=sys.stderr)
+        ok = False
+    if ex["kill_leg"]["leased_at_kill"] < 1:
+        print("[bench_exchange] FAIL: kill leg found no leased "
+              "segment at SIGKILL time — the leg proved nothing",
+              file=sys.stderr)
+        ok = False
+    if ex["kill_leg"]["leaked_after_sweep"] != 0:
+        print(f"[bench_exchange] FAIL: {ex['kill_leg']} — dead peer's "
+              "segments survived the sweep", file=sys.stderr)
+        ok = False
+    ing = planes["ingest"]
+    if not ing["byte_identical"]:
+        print("[bench_exchange] FAIL: ingest shm leg delivered "
+              "different bytes", file=sys.stderr)
+        ok = False
+    if ing["img_s_ratio_shm_over_in_band"] < 1.3:
+        print(f"[bench_exchange] FAIL: ingest shm img/s "
+              f"{ing['img_s_ratio_shm_over_in_band']:.2f}x < 1.3x",
+              file=sys.stderr)
+        ok = False
+    srv_plane = planes["serving"]
+    if not srv_plane["byte_identical"]:
+        print("[bench_exchange] FAIL: serving shm leg delivered "
+              "different page bytes", file=sys.stderr)
+        ok = False
+    if srv_plane["legs"]["shm"]["oob_bytes_recv"] <= 0:
+        print("[bench_exchange] FAIL: serving shm leg shows no lane "
+              "traffic", file=sys.stderr)
+        ok = False
+    if leaked_final:
+        print(f"[bench_exchange] FAIL: {len(leaked_final)} shm "
+              f"segment(s) leaked after all legs ({leaked_final})",
+              file=sys.stderr)
+        ok = False
+    print(f"[bench_exchange] shm-compare smoke "
+          f"{'PASS' if ok else 'FAIL'}", flush=True)
     return 0 if ok else 1
 
 
@@ -987,11 +1316,31 @@ def main(argv=None) -> int:
                          "in-step bucketed exchange refuses it — the "
                          "same matrix as the GOSGD/BSP launcher "
                          "refusals)")
+    ap.add_argument("--shm-compare", action="store_true",
+                    help="shared-memory-lane mode (ISSUE 20): in-band "
+                         "vs shm legs across the exchange, ingest and "
+                         "KV-page planes — identical workloads, fresh "
+                         "server processes, sha256 byte-identity, a "
+                         "mid-run force-disable fallback tail and a "
+                         "SIGKILL-mid-lease sweep leg; writes "
+                         "artifacts/BENCH_shm_smoke.json; with --smoke "
+                         "asserts the >=25% exchange wall cut, the "
+                         ">=1.3x ingest img/s lift, and zero leaked "
+                         "segments.  Mutually exclusive with the other "
+                         "legs")
     ap.add_argument("--smoke", action="store_true",
                     help="preflight gate: 1 exchange/mode, assert the "
                          "v2 byte win + the monitor gauge, exit 1 on "
                          "failure")
     args = ap.parse_args(argv)
+    if args.shm_compare and (args.buckets is not None
+                             or args.shards is not None
+                             or args.local_workers is not None):
+        raise FlagConflict(
+            "--shm-compare is its own multi-plane leg (exchange + "
+            "ingest + KV pages vs the shm lane) and drives its own "
+            "fleet sizes — run --buckets/--shards/--local-workers "
+            "separately")
     if args.buckets is not None and args.shards is not None:
         raise FlagConflict(
             "--buckets and --shards are mutually exclusive legs: the "
@@ -1013,6 +1362,8 @@ def main(argv=None) -> int:
     if args.local_workers is not None and args.local_workers < 1:
         raise FlagConflict(
             f"--local-workers must be >= 1, got {args.local_workers}")
+    if args.shm_compare:
+        return run_shm_compare(args)
     if args.local_workers is not None:
         return run_hierarchy(args)
     if args.buckets is not None:

@@ -161,6 +161,229 @@ def drive_trainers(addrs: list[str], data_dir: str, t_count: int,
                                1)}
 
 
+def shm_compare_leg(samples: int = 8192, store: int = 96,
+                    shard_size: int = 512, batch: int = 256,
+                    depth: int = 4) -> dict:
+    """Ingest plane of the shared-memory-lane comparison (ISSUE 20):
+    in-band wire v2 vs the shm lane over the SAME committed workload —
+    identical shard tree, epoch permutation and batch schedule, so the
+    delivered streams are sha256-checked byte-identical across legs.
+    Each leg gets a FRESH reader process (no negotiated lane state
+    leaks between legs); the parent consumes the stream directly so
+    the client-side lane counters land in the caller's monitor
+    session, which the caller owns (``monitor.registry()`` is
+    process-global).  Returns the ingest plane doc for
+    ``BENCH_shm_smoke.json``."""
+    import hashlib
+
+    from theanompi_tpu import monitor
+    from theanompi_tpu.data.imagenet import ImageNet_data
+    from theanompi_tpu.ingest.client import RemoteBatchSource
+    from theanompi_tpu.ingest.fleet import IngestProcessGroup
+    from theanompi_tpu.parallel import shm
+
+    data_dir = build_tree(samples, store, shard_size)
+    pre_segments = set(shm.segment_names())
+    prior = os.environ.get("THEANOMPI_TPU_WIRE_SHM")
+    reg = monitor.registry()
+    val = lambda name, **lb: reg.value(name, **lb) or 0.0
+    legs: dict[str, dict] = {}
+    try:
+        dataset = ImageNet_data(data_dir=data_dir, crop=store, seed=0,
+                                augment_on_device=True)
+
+        def hash_pass(addrs: list[str]) -> str:
+            """Warm pass doubling as the identity proof: sha256 over
+            every delivered byte — the same epoch-1 stream the timed
+            pass re-consumes (identical permutation + schedule)."""
+            digest = hashlib.sha256()
+            with RemoteBatchSource(addrs, data=dataset, epoch=1,
+                                   global_batch=batch,
+                                   depth=depth) as src:
+                for x, y in src:
+                    digest.update(x.tobytes())
+                    digest.update(y.tobytes())
+            return digest.hexdigest()
+
+        def timed_pass(addrs: list[str]) -> dict:
+            """Throughput pass: every byte is still READ (a training
+            step consumes the whole batch) via a cheap reduction, but
+            no cryptographic hash dilutes the transport difference —
+            the sums double as a secondary cross-leg identity check."""
+            images = nbytes = batches = 0
+            checksum = 0
+            t0 = time.monotonic()
+            with RemoteBatchSource(addrs, data=dataset, epoch=1,
+                                   global_batch=batch,
+                                   depth=depth) as src:
+                for x, y in src:
+                    checksum += int(x.sum(dtype=np.int64))
+                    checksum += int(y.sum(dtype=np.int64))
+                    batches += 1
+                    images += len(y)
+                    nbytes += x.nbytes + y.nbytes
+            wall = time.monotonic() - t0
+            return {"wall_s": round(wall, 3), "batches": batches,
+                    "images": images, "bytes": nbytes,
+                    "img_s": round(images / wall, 1),
+                    "checksum": checksum}
+
+        for name, lane in (("in_band", "0"), ("shm", "1")):
+            # the reader subprocess inherits the toggle; the parent
+            # client reads it at hello time — both sides of the leg
+            # negotiate (or never offer) the lane consistently
+            os.environ["THEANOMPI_TPU_WIRE_SHM"] = lane
+            oob0 = val("shm/oob_bytes_total", dir="recv")
+            grants0 = val("shm/grants_total", role="client")
+            group = IngestProcessGroup(1, data_dir, seed=0,
+                                       coordinator=False,
+                                       max_restarts=1)
+            try:
+                addrs = group.reader_addresses
+                sha = hash_pass(addrs)  # warm + identity evidence
+                r = timed_pass(addrs)
+                r["sha256"] = sha
+            finally:
+                group.stop()
+            r["oob_bytes_recv"] = int(
+                val("shm/oob_bytes_total", dir="recv") - oob0)
+            r["shm_grants"] = int(
+                val("shm/grants_total", role="client") - grants0)
+            legs[name] = r
+            print(f"[bench_ingest] shm-compare {name}: "
+                  f"{r['img_s']:.0f} img/s, "
+                  f"{r['oob_bytes_recv']/1e6:.1f} MB out-of-band",
+                  flush=True)
+    finally:
+        if prior is None:
+            os.environ.pop("THEANOMPI_TPU_WIRE_SHM", None)
+        else:
+            os.environ["THEANOMPI_TPU_WIRE_SHM"] = prior
+        shutil.rmtree(data_dir, ignore_errors=True)
+    shm.sweep_orphans()
+    leaked = [n for n in shm.segment_names() if n not in pre_segments]
+    ratio = legs["shm"]["img_s"] / legs["in_band"]["img_s"]
+    return {
+        "plane": "ingest",
+        "samples": samples, "store_px": store, "batch": batch,
+        "depth": depth,
+        "legs": legs,
+        "img_s_ratio_shm_over_in_band": round(ratio, 3),
+        "byte_identical": (legs["shm"]["sha256"]
+                           == legs["in_band"]["sha256"]
+                           and legs["shm"]["checksum"]
+                           == legs["in_band"]["checksum"]),
+        # payload bytes that left the socket path entirely (the
+        # receiver maps them instead of copying them off the wire)
+        "socket_bytes_saved": legs["shm"]["oob_bytes_recv"],
+        "leaked_segments": len(leaked),
+    }
+
+
+def shm_evidence(monitor_dir: str | None, since: float = 0.0) -> dict:
+    """Scan every metrics JSONL in ``monitor_dir`` written after
+    ``since`` for shared-memory-lane evidence.  Subprocess roles
+    (readers, shards, prefill/decode replicas) run their OWN monitor
+    sessions writing sibling ``metrics_*.jsonl`` files into the shared
+    dir, so the parent's snapshot alone never shows the server side of
+    the lane — this aggregates both sides.  Counter snapshots are
+    cumulative, so per (file, name, labels) the LAST value wins."""
+    grants = 0.0
+    oob = 0.0
+    if not monitor_dir or not os.path.isdir(monitor_dir):
+        return {"grants": 0, "oob_bytes": 0}
+    for fn in sorted(os.listdir(monitor_dir)):
+        path = os.path.join(monitor_dir, fn)
+        if not fn.endswith(".jsonl"):
+            continue
+        try:
+            if os.path.getmtime(path) < since:
+                continue
+            last: dict[str, float] = {}
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    name = rec.get("name")
+                    if name in ("shm/grants_total",
+                                "shm/oob_bytes_total"):
+                        key = f"{name}|{sorted((rec.get('labels') or {}).items())}"
+                        last[key] = float(rec.get("value") or 0.0)
+            for key, v in last.items():
+                if key.startswith("shm/grants_total"):
+                    grants += v
+                else:
+                    oob += v
+        except OSError:
+            continue
+    return {"grants": int(grants), "oob_bytes": int(oob)}
+
+
+def run_shm_compare(args) -> int:
+    """``--shm-compare`` mode: the standalone ingest shm leg —
+    in-band vs lane over the identical stream, fresh reader process
+    per leg; with ``--smoke`` asserts the >= ``--shm-bar`` img/s
+    lift, byte identity, lane evidence, and zero leaked segments."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    os.environ.setdefault("THEANOMPI_TPU_SERVICE_KEY", "bench-ingest")
+    os.environ.setdefault(
+        "THEANOMPI_TPU_MONITOR",
+        os.path.join(REPO, "artifacts", "bench_ingest_monitor"))
+
+    from theanompi_tpu import monitor
+
+    n_samples = args.samples or (8192 if args.smoke else 16384)
+    # the lane targets payload-dominated batches (pixels >> skeleton);
+    # the default 64-image batch is a latency workload, not this one
+    batch = max(args.batch, 256)
+    with monitor.session():
+        doc = shm_compare_leg(n_samples, args.store, args.shard_size,
+                              batch, args.depth)
+    out_doc = {"bench": "ingest_shm_lane", "backend": "cpu", **doc}
+    tag = args.tag or "ingest_shm"
+    path = args.out or os.path.join(REPO, "artifacts",
+                                    f"BENCH_{tag}.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out_doc, f, indent=1)
+    print(f"[bench_ingest] wrote {path} (shm "
+          f"{doc['img_s_ratio_shm_over_in_band']:.2f}x in-band img/s)",
+          flush=True)
+    if not args.smoke:
+        return 0
+    ok = True
+    if not doc["byte_identical"]:
+        print("[bench_ingest] FAIL: shm leg delivered different bytes "
+              "than the in-band leg", file=sys.stderr)
+        ok = False
+    if doc["img_s_ratio_shm_over_in_band"] < args.shm_bar:
+        print(f"[bench_ingest] FAIL: shm img/s "
+              f"{doc['img_s_ratio_shm_over_in_band']:.2f}x in-band < "
+              f"{args.shm_bar}x bar", file=sys.stderr)
+        ok = False
+    if doc["legs"]["shm"]["oob_bytes_recv"] <= 0 \
+            or doc["legs"]["shm"]["shm_grants"] < 1:
+        print("[bench_ingest] FAIL: shm leg shows no lane traffic "
+              f"({doc['legs']['shm']})", file=sys.stderr)
+        ok = False
+    if doc["legs"]["in_band"]["oob_bytes_recv"] != 0:
+        print("[bench_ingest] FAIL: in-band leg leaked lane traffic "
+              f"({doc['legs']['in_band']})", file=sys.stderr)
+        ok = False
+    if doc["leaked_segments"]:
+        print(f"[bench_ingest] FAIL: {doc['leaked_segments']} shm "
+              "segment(s) leaked after the legs", file=sys.stderr)
+        ok = False
+    print(f"[bench_ingest] shm-compare {'PASS' if ok else 'FAIL'}",
+          flush=True)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--readers", type=int, default=2, metavar="N")
@@ -169,8 +392,10 @@ def main(argv=None) -> int:
                          "reader's capacity or N=1 vs N=2 compares "
                          "two idle fleets")
     ap.add_argument("--batch", type=int, default=64)
-    ap.add_argument("--store", type=int, default=64,
-                    help="stored image side (uint8 HxWx3)")
+    ap.add_argument("--store", type=int, default=None,
+                    help="stored image side (uint8 HxWx3); default 64, "
+                         "96 under --shm-compare (payload-dominated "
+                         "batches are the lane's target workload)")
     ap.add_argument("--samples", type=int, default=None,
                     help="dataset size (default 65536; 32768 in "
                          "--smoke)")
@@ -184,6 +409,16 @@ def main(argv=None) -> int:
                     help="--smoke: required N=2/N=1 aggregate ratio")
     ap.add_argument("--out", default=None)
     ap.add_argument("--tag", default=None)
+    ap.add_argument("--shm-compare", action="store_true",
+                    help="shared-memory-lane leg (ISSUE 20): in-band "
+                         "vs shm over the identical stream, one fresh "
+                         "reader process per leg, sha256 byte-identity "
+                         "checked; with --smoke asserts the --shm-bar "
+                         "img/s lift + lane evidence + zero leaked "
+                         "segments")
+    ap.add_argument("--shm-bar", type=float, default=1.3,
+                    help="--shm-compare --smoke: required shm/in-band "
+                         "aggregate img/s ratio")
     ap.add_argument("--smoke", action="store_true",
                     help="preflight gate: assert the scaling bar, the "
                          "kill-recovery leg, and the monitor evidence; "
@@ -196,9 +431,13 @@ def main(argv=None) -> int:
     ap.add_argument("--worker-addrs", default=None,
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.store is None:
+        args.store = 96 if args.shm_compare else 64
     if args.worker_rank is not None:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         return trainer_worker(args)
+    if args.shm_compare:
+        return run_shm_compare(args)
 
     # ingest is a host-plane bench: numpy + sockets; keep jax off any
     # real accelerator in every process of the fleet
@@ -228,6 +467,7 @@ def main(argv=None) -> int:
 
     modes = []
     kill = None
+    t_start = time.time()
     try:
         with monitor.session():
             for n_readers in ([1, args.readers]
@@ -287,7 +527,7 @@ def main(argv=None) -> int:
 
     if not args.smoke:
         return 0
-    return smoke_verdict(out_doc, args, snapshot_path)
+    return smoke_verdict(out_doc, args, snapshot_path, since=t_start)
 
 
 def reader_served(addrs: list[str]) -> list[int]:
@@ -333,7 +573,8 @@ def kill_leg(group, ds, args) -> dict:
     return out
 
 
-def smoke_verdict(doc: dict, args, snapshot_path: str | None) -> int:
+def smoke_verdict(doc: dict, args, snapshot_path: str | None,
+                  since: float = 0.0) -> int:
     ok = True
     if args.readers < 2:
         print("[bench_ingest] FAIL: smoke needs --readers >= 2",
@@ -382,6 +623,22 @@ def smoke_verdict(doc: dict, args, snapshot_path: str | None) -> int:
         if needed not in names:
             print(f"[bench_ingest] FAIL: {needed} missing from the "
                   f"monitor JSONL ({snapshot_path})", file=sys.stderr)
+            ok = False
+    # shm-lane evidence (ISSUE 20): same-host readers must have
+    # granted the lane and shipped batch pixels out-of-band.  The
+    # trainer workers run no monitor session, so the proof lives in
+    # the READER processes' sibling metrics files — scan the dir.
+    from theanompi_tpu.parallel import shm
+
+    if shm.enabled() and shm.available():
+        mon_dir = os.path.dirname(snapshot_path) if snapshot_path \
+            else os.environ.get("THEANOMPI_TPU_MONITOR")
+        ev = shm_evidence(mon_dir, since=since)
+        if ev["grants"] < 1 or ev["oob_bytes"] <= 0:
+            print(f"[bench_ingest] FAIL: no shm-lane evidence in the "
+                  f"monitor dir ({mon_dir}): {ev} — same-host readers "
+                  "should have granted the lane and shipped batches "
+                  "out-of-band", file=sys.stderr)
             ok = False
     print(f"[bench_ingest] smoke {'PASS' if ok else 'FAIL'}",
           flush=True)

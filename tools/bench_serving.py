@@ -1130,6 +1130,179 @@ def mixed_main(args, tmp_dir: str) -> dict:
     return out
 
 
+def shm_compare_leg(tmp_dir: str, rounds: int = 10) -> dict:
+    """KV-page plane of the shared-memory-lane comparison (ISSUE 20):
+    a prefill replica (FRESH subprocess per leg) ships KV pages to the
+    client over wire v2 — in-band vs the shm lane — for the SAME
+    prompt set, with the k/v page bytes sha256-checked byte-identical
+    across legs.  The caller owns the enclosing monitor session (the
+    client-side lane counters are registry-global)."""
+    import hashlib
+    import subprocess
+
+    from theanompi_tpu import monitor
+    from theanompi_tpu.frontdoor.prefill import PrefillClient
+    from theanompi_tpu.parallel import shm
+
+    export_dir = _demo_export(tmp_dir, decode=True, d_model=64,
+                              n_layers=2, n_heads=4, vocab=64,
+                              seq_len=64)
+    rng = np.random.default_rng(20)
+    prompts = [(rng.integers(0, 62, 24).astype(np.int32) + 1)
+               for _ in range(4)]
+    pre_segments = set(shm.segment_names())
+    reg = monitor.registry()
+    val = lambda name, **lb: reg.value(name, **lb) or 0.0
+    prior = {k: os.environ.get(k) for k in
+             ("THEANOMPI_TPU_WIRE_SHM", "THEANOMPI_TPU_SHM_MIN_BYTES")}
+    legs: dict[str, dict] = {}
+    try:
+        # the tiny demo net's KV pages are tens of KB — under the
+        # default 64 KiB lane floor; BOTH legs run the same lowered
+        # floor so the comparison stays like-for-like
+        os.environ["THEANOMPI_TPU_SHM_MIN_BYTES"] = "1024"
+        for name, lane in (("in_band", "0"), ("shm", "1")):
+            os.environ["THEANOMPI_TPU_WIRE_SHM"] = lane
+            port = _free_port()
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "theanompi_tpu.frontdoor.prefill",
+                 "--export-dir", export_dir, "--host", "127.0.0.1",
+                 "--port", str(port), "--page-size", "16",
+                 "--pages-per-seq", "4", "--max-seqs", "8",
+                 "--max-pending", "8", "--prefill-batch", "1",
+                 "--prefill-delay-ms", "0", "--platform", "cpu"],
+                env=dict(os.environ))
+            c = None
+            deadline = time.monotonic() + 180
+            while c is None:
+                try:
+                    c = PrefillClient(f"127.0.0.1:{port}")
+                    c.ping()
+                except Exception:
+                    if c is not None:
+                        c.close()
+                    c = None
+                    if proc.poll() is not None:
+                        raise RuntimeError(
+                            f"prefill replica died (rc={proc.poll()})")
+                    if time.monotonic() > deadline:
+                        proc.kill()
+                        raise RuntimeError(
+                            "prefill replica never came up")
+                    time.sleep(0.3)
+            oob0 = val("shm/oob_bytes_total", dir="recv")
+            grants0 = val("shm/grants_total", role="client")
+            digest = hashlib.sha256()
+            page_bytes = 0
+            try:
+                for p in prompts:  # warm: prefill program compile
+                    c.prefill(p)
+                t0 = time.monotonic()
+                for _ in range(rounds):
+                    for p in prompts:
+                        _, k, v = c.prefill(p)
+                        digest.update(k.tobytes())
+                        digest.update(v.tobytes())
+                        page_bytes += k.nbytes + v.nbytes
+                wall = time.monotonic() - t0
+            finally:
+                try:
+                    c.shutdown()
+                except Exception:
+                    pass
+                c.close()
+                try:
+                    proc.wait(timeout=20)
+                except Exception:
+                    proc.kill()
+                    proc.wait(timeout=10)
+            n = rounds * len(prompts)
+            legs[name] = {
+                "prefills": n,
+                "wall_s": round(wall, 3),
+                "prefill_ms_mean": round(wall / n * 1e3, 2),
+                "page_bytes": page_bytes,
+                "sha256": digest.hexdigest(),
+                "oob_bytes_recv": int(
+                    val("shm/oob_bytes_total", dir="recv") - oob0),
+                "shm_grants": int(
+                    val("shm/grants_total", role="client") - grants0),
+            }
+            print(f"[bench_serving] shm-compare {name}: "
+                  f"{legs[name]['prefill_ms_mean']:.1f} ms/prefill, "
+                  f"{legs[name]['oob_bytes_recv']/1e6:.1f} MB "
+                  "out-of-band", flush=True)
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    shm.sweep_orphans()
+    leaked = [n for n in shm.segment_names() if n not in pre_segments]
+    return {
+        "plane": "serving_kv",
+        "rounds": rounds, "prompts": len(prompts),
+        "legs": legs,
+        "byte_identical": (legs["shm"]["sha256"]
+                           == legs["in_band"]["sha256"]),
+        "wall_delta_pct": round(
+            100.0 * (1.0 - legs["shm"]["wall_s"]
+                     / legs["in_band"]["wall_s"]), 1),
+        # page bytes that left the socket path entirely (the client
+        # maps them instead of copying them off the wire)
+        "socket_bytes_saved": legs["shm"]["oob_bytes_recv"],
+        "leaked_segments": len(leaked),
+    }
+
+
+def shm_compare_main(args) -> int:
+    """``--shm-compare``: the standalone KV-page shm leg.  Always a
+    gate — exits 1 unless the lane carried the pages, the delivered
+    bytes are identical to the in-band leg, and nothing leaked."""
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("THEANOMPI_TPU_SERVICE_KEY", "bench-serving")
+    from theanompi_tpu import monitor
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as td:
+        with monitor.session(os.path.join(td, "monitor")):
+            doc = shm_compare_leg(td)
+    out_doc = {"bench": "serving_shm_lane", **doc}
+    path = (args.out if args.out != "BENCH_serving.json"
+            else os.path.join(repo, "artifacts",
+                              "BENCH_serving_shm.json"))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out_doc, f, indent=1)
+    print(f"[bench_serving] wrote {path} (shm wall delta "
+          f"{doc['wall_delta_pct']:+.1f}%)", flush=True)
+    ok = True
+    if not doc["byte_identical"]:
+        print("[bench_serving] FAIL: shm leg delivered different page "
+              "bytes than the in-band leg", file=sys.stderr)
+        ok = False
+    if doc["legs"]["shm"]["oob_bytes_recv"] <= 0 \
+            or doc["legs"]["shm"]["shm_grants"] < 1:
+        print("[bench_serving] FAIL: shm leg shows no lane traffic "
+              f"({doc['legs']['shm']})", file=sys.stderr)
+        ok = False
+    if doc["legs"]["in_band"]["oob_bytes_recv"] != 0:
+        print("[bench_serving] FAIL: in-band leg leaked lane traffic",
+              file=sys.stderr)
+        ok = False
+    if doc["leaked_segments"]:
+        print(f"[bench_serving] FAIL: {doc['leaked_segments']} shm "
+              "segment(s) leaked", file=sys.stderr)
+        ok = False
+    print(f"[bench_serving] shm-compare {'PASS' if ok else 'FAIL'}",
+          flush=True)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--addr", default=None,
@@ -1271,9 +1444,18 @@ def main(argv=None) -> int:
     ap.add_argument("--demo-draft-d-model", type=int, default=64)
     ap.add_argument("--demo-draft-layers", type=int, default=1)
     ap.add_argument("--demo-draft-heads", type=int, default=2)
+    ap.add_argument("--shm-compare", action="store_true",
+                    help="shared-memory-lane leg (ISSUE 20): ship the "
+                         "SAME KV pages from a fresh prefill "
+                         "subprocess in-band vs over the shm lane, "
+                         "byte-identity-checked; exits 1 unless the "
+                         "lane carried the pages with zero leaked "
+                         "segments")
     ap.add_argument("--out", default="BENCH_serving.json")
     args = ap.parse_args(argv)
 
+    if args.shm_compare:
+        return shm_compare_main(args)
     if args.prefill_compare or args.mode in ("trace", "mixed-trace"):
         if not args.decode:
             ap.error("--prefill-compare is a --decode mode"

@@ -11,6 +11,40 @@
 set -u
 LOG="${1:-artifacts/preflight.log}"
 cd "$(dirname "$0")/.."
+# shm-lane evidence scan (ISSUE 20): the same-host smokes must show
+# the shared-memory lane actually carried payload — a grant landed
+# AND out-of-band bytes flowed — in the monitor JSONL the smoke just
+# wrote.  Returns 1 (and prints what's missing) if the lane silently
+# fell back everywhere, which would mean the negotiation or adopter
+# wiring regressed while the in-band fallback kept the smoke green.
+shm_lane_evidence() {  # $1 = monitor dir, $2 = plane label
+  python - "$1" "$2" <<'PYEOF'
+import glob, json, os, sys
+mondir, label = sys.argv[1], sys.argv[2]
+grants = oob = 0.0
+for path in glob.glob(os.path.join(mondir, "**", "*.jsonl"),
+                      recursive=True):
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        # role exporters write flat per-series records; the collector
+        # wraps a snapshot list inside event=metrics records
+        series = [rec] if "name" in rec else rec.get("snapshot") or []
+        for s in series:
+            if s.get("name") == "shm/grants_total":
+                grants = max(grants, s.get("value") or 0.0)
+            elif s.get("name") == "shm/oob_bytes_total":
+                oob = max(oob, s.get("value") or 0.0)
+if grants < 1 or oob <= 0:
+    print(f"shm lane evidence MISSING for {label}: "
+          f"grants={grants:.0f} oob_bytes={oob:.0f}")
+    sys.exit(1)
+print(f"shm lane evidence ({label}): grants>={grants:.0f}, "
+      f"{oob/1e6:.3f} MB out-of-band")
+PYEOF
+}
 {
   echo "# preflight $(date -u +%Y-%m-%dT%H:%M:%SZ) HEAD=$(git rev-parse --short HEAD)"
   echo "## tmlint --gate (static checker suite, docs/ANALYSIS.md)"
@@ -471,8 +505,12 @@ PYEOF
   # JSONL), and a prompt prefilled on the cache authority must FLEET-
   # HIT from the peer replica — shipped pages, byte-identical output,
   # zero leaked leases — instead of recomputing the prefix
+  # the toy model's KV pages are ~KB-scale — far under the 64KB
+  # default lane floor — so drop the floor for this smoke to prove
+  # the disagg page-migration path inherits the lane end-to-end
   FRONTDIR="$(mktemp -d)"
-  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$FRONTDIR" python - <<'PYEOF'
+  JAX_PLATFORMS=cpu THEANOMPI_TPU_MONITOR="$FRONTDIR" \
+    THEANOMPI_TPU_SHM_MIN_BYTES=256 python - <<'PYEOF'
 import os, sys, threading, time
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -641,6 +679,11 @@ PYEOF
     sed -n '1,8p' "$FRONTDIR/traces.out"
     FRONTDOOR_RC=$FTRACES_RC
   fi
+  # page migration + fleet-cache ship between same-host replicas must
+  # have granted the lane and moved KV pages out-of-band
+  if [ "$FRONTDOOR_RC" -eq 0 ]; then
+    shm_lane_evidence "$FRONTDIR" "disagg kv pages" || FRONTDOOR_RC=1
+  fi
   rm -rf "$FRONTDIR"
   echo "frontdoor smoke rc=$FRONTDOOR_RC"
   echo "## exchange-bench smoke (wire v1 vs v2 over real sockets, docs/DESIGN.md 'Wire protocol v2')"
@@ -684,6 +727,11 @@ PYEOF
     python tools/bench_exchange.py --smoke --shards 2 \
       --out "$SHARDDIR/BENCH_shard_smoke.json"
   SHARD_RC=$?
+  # same-host shards: the shm lane must have granted and carried the
+  # exchange payload out-of-band (docs/DESIGN.md 'Shared-memory lane')
+  if [ "$SHARD_RC" -eq 0 ]; then
+    shm_lane_evidence "$SHARDDIR" "shard exchange" || SHARD_RC=1
+  fi
   rm -rf "$SHARDDIR"
   echo "shard smoke rc=$SHARD_RC"
   echo "## hierarchy smoke (4 local workers -> 1 aggregator -> 2 real shard processes, docs/DESIGN.md 'Hierarchical exchange')"
@@ -728,6 +776,10 @@ PYEOF
     python tools/bench_ingest.py --smoke \
       --out "$INGESTDIR/BENCH_ingest_smoke.json"
   INGEST_RC=$?
+  # same-host readers: batch frames must have ridden the shm lane
+  if [ "$INGEST_RC" -eq 0 ]; then
+    shm_lane_evidence "$INGESTDIR" "ingest batches" || INGEST_RC=1
+  fi
   rm -rf "$INGESTDIR"
   echo "ingest smoke rc=$INGEST_RC"
   echo "## rpc smoke (concurrent-connection scaling on the selector event plane, docs/DESIGN.md 'RPC substrate')"
